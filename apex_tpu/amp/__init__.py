@@ -1,0 +1,26 @@
+"""apex_tpu.amp — mixed precision with O0–O3 opt levels on TPU.
+
+Reference package: ``apex/amp`` (``apex/amp/__init__.py:1-5``).
+"""
+
+from apex_tpu.amp.frontend import (  # noqa: F401
+    initialize,
+    state_dict,
+    load_state_dict,
+    make_train_step,
+    AmpModel,
+)
+from apex_tpu.amp.handle import scale_loss, disable_casts, AmpHandle, NoOpHandle  # noqa: F401
+from apex_tpu.amp.policy import (  # noqa: F401
+    autocast,
+    half_function,
+    float_function,
+    promote_function,
+    register_half_function,
+    register_float_function,
+    register_promote_function,
+    autocast_enabled,
+)
+from apex_tpu.amp.properties import Properties, opt_levels  # noqa: F401
+from apex_tpu.amp.scaler import LossScaler, ScalerState, init_state  # noqa: F401
+from apex_tpu.amp import scaler  # noqa: F401

@@ -1,0 +1,39 @@
+"""Process-global amp state + rank-aware printing.
+
+Reference: ``apex/amp/_amp_state.py:7-50``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class AmpState:
+    def __init__(self):
+        self.hard_override = False
+        self.allow_incoming_model_not_fp32 = False
+        self.verbosity = 1
+        self.handle = None
+        self.opt_properties = None
+        self.loss_scalers: list = []
+
+
+_amp_state = AmpState()
+
+
+def master_only() -> bool:
+    return jax.process_index() == 0
+
+
+def maybe_print(msg: str, rank0: bool = False):
+    """Verbosity-gated, optionally rank-0-only printing
+    (``apex/amp/_amp_state.py:38-50``)."""
+    if _amp_state.verbosity > 0 and (not rank0 or master_only()):
+        print(msg)
+
+
+def warn_or_err(msg: str):
+    if _amp_state.hard_override:
+        maybe_print("Warning: " + msg)
+    else:
+        raise RuntimeError(msg)

@@ -1,0 +1,264 @@
+"""amp frontend: ``initialize``, opt-level application, checkpoint state.
+
+Reference: ``apex/amp/frontend.py:195-400`` + ``apex/amp/_initialize.py:145-263``.
+
+Apex mutates a live torch model (casts modules, patches ``forward``,
+monkey-patches the optimizer instance). The TPU-native translation keeps
+the same *decision logic* (opt-level defaults + explicit-override
+validation) but applies it functionally:
+
+- ``initialize(model, optimizers, opt_level, ...)`` returns an
+  :class:`AmpModel` wrapper (casts inputs/outputs, applies the O1 autocast
+  policy around the forward) and the optimizer(s) with amp state attached
+  (scaler + properties; our optimizers consult this in ``step`` for
+  master-weight and skip-on-overflow behavior).
+- parameter casting is explicit: ``amp_model.cast_params(params)`` —
+  params are data in JAX, not module state.
+- ``make_train_step`` builds the fully-jitted hot path (scale → grad →
+  unscale → cond-skip step → scale update) with zero host syncs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import policy as _policy_mod
+from apex_tpu.amp import scaler as _scaler_mod
+from apex_tpu.amp._amp_state import _amp_state, maybe_print, warn_or_err
+from apex_tpu.amp.properties import Properties, opt_levels
+from apex_tpu.amp.scaler import LossScaler, ScalerState
+from apex_tpu.utils.tree import cast_floating
+
+
+def _is_norm_param(path_names: tuple[str, ...]) -> bool:
+    """Name-based analog of ``isinstance(module, _BatchNorm)``
+    (``apex/fp16_utils/fp16util.py:27-39``): flax/haiku BN scopes are named
+    ``BatchNorm*`` / ``bn*`` / ``batch_stats``."""
+    joined = "/".join(path_names).lower()
+    return any(k in joined for k in ("batchnorm", "batch_norm", "batch_stats", "/bn", "bn_", "sync_bn", "syncbn"))
+
+
+class AmpModel:
+    """Forward-pass wrapper produced by :func:`initialize`.
+
+    Mirrors the patched ``model.forward`` of ``apex/amp/_initialize.py:190-201``
+    (cast inputs to the model dtype, optionally cast outputs) plus the O1
+    autocast context. Callable as ``amp_model(params, *args, **kwargs)``
+    where the underlying model is ``apply_fn(params, *args, **kwargs)``.
+    """
+
+    def __init__(self, apply_fn: Callable, properties: Properties,
+                 keep_fp32_predicate: Callable | None = None):
+        self.apply_fn = apply_fn
+        self.properties = properties
+        self._keep_fp32 = keep_fp32_predicate or (
+            (lambda names, x: not _is_norm_param(names))
+            if properties.keep_batchnorm_fp32 else None
+        )
+
+    def cast_params(self, params: Any) -> Any:
+        """Cast a parameter pytree per the opt level.
+
+        O2/O3: floating leaves → half (batchnorm leaves exempt under O2,
+        cf. ``convert_network`` ``apex/fp16_utils/fp16util.py:60``).
+        O0: → fp32. O1: untouched (weights stay fp32; ops cast).
+        """
+        ct = self.properties.cast_model_type
+        if ct is None:
+            return params
+        return cast_floating(params, ct, self._keep_fp32)
+
+    def __call__(self, params, *args, **kwargs):
+        p = self.properties
+        if p.cast_model_type is not None and p.cast_model_type != jnp.float32:
+            args = cast_floating(args, p.cast_model_type)
+            kwargs = cast_floating(kwargs, p.cast_model_type)
+        if p.cast_ops:
+            with _policy_mod.autocast(True, p.half_dtype):
+                out = self.apply_fn(params, *args, **kwargs)
+        else:
+            out = self.apply_fn(params, *args, **kwargs)
+        if p.cast_model_outputs is not None:
+            out = cast_floating(out, p.cast_model_outputs)
+        elif p.cast_model_type is not None and p.cast_model_type != jnp.float32:
+            # O2/O3 patched forward casts outputs back to fp32
+            # (apex/amp/_initialize.py:198-201 applier(out, to_type(fp32)))
+            out = cast_floating(out, jnp.float32)
+        return out
+
+
+class _AmpStash:
+    """Attached to each optimizer, mirroring ``optimizer._amp_stash``
+    (``apex/amp/_process_optimizer.py:324-339``)."""
+
+    def __init__(self, properties: Properties, loss_scalers: list[LossScaler]):
+        self.properties = properties
+        self.loss_scalers = loss_scalers
+        self.already_patched = True
+
+
+def initialize(
+    models,
+    optimizers=None,
+    opt_level: str = "O1",
+    *,
+    half_dtype=None,
+    cast_model_type=None,
+    cast_ops=None,
+    keep_batchnorm_fp32=None,
+    master_weights=None,
+    loss_scale=None,
+    cast_model_outputs=None,
+    num_losses: int = 1,
+    verbosity: int = 1,
+    min_loss_scale: float | None = None,
+    max_loss_scale: float = 2.0 ** 24,
+    keep_fp32_predicate: Callable | None = None,
+):
+    """Initialize amp. Reference: ``amp.initialize`` ``apex/amp/frontend.py:195-358``.
+
+    ``models``: an ``apply_fn(params, *args)``, an object with ``.apply``
+    (flax ``nn.Module``), or a list of either. ``optimizers``: apex_tpu
+    optimizer instance(s) (may be None for inference, frontend.py:298-306).
+
+    Returns ``(models, optimizers)`` with the same list-ness as the inputs
+    (frontend.py:342-358).
+    """
+    _amp_state.verbosity = verbosity
+    if opt_level not in opt_levels:
+        raise RuntimeError(f"Unexpected optimization level {opt_level}. Options are 'O0', 'O1', 'O2', 'O3'.")
+
+    properties = Properties()
+    if half_dtype is not None:
+        properties.half_dtype = half_dtype
+    properties = opt_levels[opt_level](properties)
+    maybe_print(f"Selected optimization level {opt_level}: {opt_levels[opt_level].brief}", True)
+
+    # Explicit overrides win over opt-level defaults (frontend.py:336-356).
+    overrides = dict(
+        cast_model_type=cast_model_type,
+        cast_ops=cast_ops,
+        keep_batchnorm_fp32=keep_batchnorm_fp32,
+        master_weights=master_weights,
+        loss_scale=loss_scale,
+        cast_model_outputs=cast_model_outputs,
+    )
+    for k, v in overrides.items():
+        if v is not None:
+            maybe_print(f"Overriding {k}: {v}", True)
+            setattr(properties, k, v)
+
+    # Consistency checks analogous to Properties.__setattr__ validation
+    # (apex/amp/frontend.py:40-97).
+    if properties.keep_batchnorm_fp32 and properties.cast_model_type is None:
+        warn_or_err("keep_batchnorm_fp32 only makes sense with a cast_model_type (O2/O3).")
+    if properties.master_weights and properties.cast_model_type is None:
+        warn_or_err("master_weights requires cast_model_type (O2).")
+
+    _amp_state.opt_properties = properties
+
+    models_was_list = isinstance(models, (list, tuple))
+    model_list = list(models) if models_was_list else [models]
+    amp_models = []
+    for m in model_list:
+        apply_fn = m.apply if hasattr(m, "apply") else m  # flax Module or callable
+        amp_models.append(AmpModel(apply_fn, properties, keep_fp32_predicate))
+
+    scalers = [
+        LossScaler(
+            properties.loss_scale,
+            min_loss_scale=min_loss_scale,
+            max_loss_scale=max_loss_scale,
+        )
+        for _ in range(num_losses)
+    ]
+    _amp_state.loss_scalers = scalers
+
+    opts_was_list = isinstance(optimizers, (list, tuple))
+    opt_list = list(optimizers) if opts_was_list else ([optimizers] if optimizers is not None else [])
+    for opt in opt_list:
+        opt._amp_stash = _AmpStash(properties, scalers)
+        if hasattr(opt, "configure_amp"):
+            opt.configure_amp(properties, scalers[0])
+
+    out_models = amp_models if models_was_list else amp_models[0]
+    if optimizers is None:
+        return out_models
+    out_opts = opt_list if opts_was_list else opt_list[0]
+    return out_models, out_opts
+
+
+# ---------------------------------------------------------------------------
+# Checkpointing: amp.state_dict / amp.load_state_dict
+# (apex/amp/frontend.py:361-400 — serializes every loss scaler's scale and
+# unskipped count)
+# ---------------------------------------------------------------------------
+
+def state_dict() -> dict:
+    d = {}
+    for i, s in enumerate(_amp_state.loss_scalers):
+        d[f"loss_scaler{i}"] = s.state_dict()
+    return d
+
+
+def load_state_dict(sd: dict):
+    if len(sd) != len(_amp_state.loss_scalers):
+        maybe_print(
+            f"Warning: state_dict has {len(sd)} entries but amp has "
+            f"{len(_amp_state.loss_scalers)} scalers", True)
+    for key, v in sd.items():
+        idx = int(key.replace("loss_scaler", ""))
+        if idx < len(_amp_state.loss_scalers):
+            _amp_state.loss_scalers[idx].load_state_dict(v)
+
+
+# ---------------------------------------------------------------------------
+# The fully-jitted hot path (SURVEY §7 hard-parts: dynamic loss scaling
+# under jit with zero per-step host syncs).
+# ---------------------------------------------------------------------------
+
+def make_train_step(
+    loss_fn: Callable,
+    optimizer,
+    *,
+    scaler: LossScaler | None = None,
+    has_aux: bool = False,
+    grad_dtype=jnp.float32,
+    donate: bool = True,
+):
+    """Build a jitted training step with amp semantics.
+
+    ``loss_fn(params, *batch) -> loss`` (or ``(loss, aux)``). ``optimizer``
+    is an apex_tpu fused optimizer (functional core: ``init``/``apply``).
+
+    The returned ``step(params, opt_state, scaler_state, *batch)`` performs
+    the whole of apex's hot loop (``apex/amp/handle.py:16-158`` +
+    ``_process_optimizer.py:161-202``): scaled-loss grad, unscale with
+    overflow detect, conditional skip of the optimizer step on overflow
+    (apex patches ``optimizer.step`` to a no-op; here it is a ``jnp.where``
+    on the update), and dynamic scale update — all inside one XLA program.
+    """
+    scaler = scaler or (optimizer._amp_stash.loss_scalers[0]
+                        if hasattr(optimizer, "_amp_stash") else LossScaler(1.0))
+
+    def scaled_loss_fn(params, scaler_state, *batch):
+        out = loss_fn(params, *batch)
+        loss, aux = (out if has_aux else (out, None))
+        return _scaler_mod.scale_value(loss, scaler_state), (loss, aux)
+
+    grad_fn = jax.grad(scaled_loss_fn, has_aux=True)
+
+    def step(params, opt_state, scaler_state: ScalerState, *batch):
+        grads, (loss, aux) = grad_fn(params, scaler_state, *batch)
+        grads, found_inf = _scaler_mod.unscale(grads, scaler_state, out_dtype=grad_dtype)
+        new_params, new_opt_state = optimizer.apply(
+            opt_state, params, grads, skip=found_inf
+        )
+        new_scaler_state = scaler.update_state(scaler_state, found_inf)
+        outs = (new_params, new_opt_state, new_scaler_state, loss)
+        return outs + ((aux,) if has_aux else ())
+
+    return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())

@@ -1,0 +1,118 @@
+"""``amp.scale_loss`` context manager and legacy handles.
+
+Reference: ``apex/amp/handle.py:16-281``. Apex's context manager yields
+``loss * scale`` and, on exit, unscales the ``.grad`` attributes the user's
+``backward()`` populated, then patches ``optimizer.step`` to skip on
+overflow. JAX gradients are values, not attributes, so the contract here
+is:
+
+    with amp.scale_loss(loss, optimizer) as scaled_loss:
+        grads = <grads of the scaled loss>              # user-side
+        optimizer.step(grads)   # unscales + skips-on-overflow internally
+
+i.e. the context manager scales the loss and arms the optimizer's scaler;
+the unscale/skip logic runs inside the optimizer step (mirroring
+``_post_amp_backward``, ``apex/amp/_process_optimizer.py:161-202``), and
+``step`` is called *inside* the context so the exit-time overflow report
+reflects this iteration. This eager API pays one host sync per iteration
+for the report; the fully-jitted zero-sync path is ``amp.make_train_step``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from apex_tpu.amp import scaler as _scaler_mod
+from apex_tpu.amp._amp_state import _amp_state, maybe_print
+
+
+@contextlib.contextmanager
+def scale_loss(loss, optimizers, loss_id: int = 0, model=None, delay_unscale: bool = False):
+    """Yield the scaled loss; on exit update the scaler from observed state.
+
+    ``delay_unscale`` mirrors ``apex/amp/handle.py:67-79`` (gradient
+    accumulation: skip unscale/update this iteration).
+    """
+    if not _amp_state.loss_scalers:
+        # amp not initialized → passthrough, like handle.py:21-29
+        yield loss
+        return
+
+    loss_scaler = _amp_state.loss_scalers[loss_id]
+    opt_list = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+    for opt in opt_list:
+        if hasattr(opt, "arm_scaler"):
+            opt.arm_scaler(loss_scaler, delay_unscale=delay_unscale)
+
+    yield _scaler_mod.scale_value(jnp.asarray(loss), loss_scaler.state)
+
+    if delay_unscale:
+        return
+    # If the user called optimizer.step(grads) inside the context (the
+    # documented flow), the scaler state now reflects this iteration;
+    # surface the skip message like handle.py:138-140.
+    if bool(loss_scaler.state.overflow):
+        maybe_print(
+            f"Gradient overflow.  Skipping step, loss scaler {loss_id} reducing "
+            f"loss scale to {float(loss_scaler.state.loss_scale)}")
+
+
+@contextlib.contextmanager
+def disable_casts():
+    """``amp.handle.disable_casts`` parity (``apex/amp/handle.py:156-164``)."""
+    from apex_tpu.amp.policy import autocast
+    with autocast(False):
+        yield
+
+
+class AmpHandle:
+    """Legacy handle API (``apex/amp/handle.py:170-251``)."""
+
+    def __init__(self, loss_scale="dynamic", enable_caching=True, verbose=False):
+        self._enable_caching = enable_caching
+        self._verbose = verbose
+        from apex_tpu.amp.scaler import LossScaler
+        self._default_scaler = LossScaler(loss_scale)
+        self._is_active = True
+        self._all_wrappers = []
+
+    def is_active(self):
+        return self._is_active
+
+    @contextlib.contextmanager
+    def _disable_casts(self):
+        with disable_casts():
+            yield
+
+    def scale_loss(self, loss, optimizer):
+        return scale_loss(loss, optimizer)
+
+    @property
+    def has_cache(self):
+        return self._enable_caching
+
+    def _clear_cache(self):
+        pass  # XLA CSE makes the weight-cast cache unnecessary
+
+
+class NoOpHandle:
+    """``apex/amp/handle.py:254-281``."""
+
+    def is_active(self):
+        return False
+
+    @contextlib.contextmanager
+    def _disable_casts(self):
+        yield
+
+    def scale_loss(self, loss, optimizer):
+        return contextlib.nullcontext(loss)
+
+    @property
+    def has_cache(self):
+        return False
+
+    def _clear_cache(self):
+        pass

@@ -1,0 +1,179 @@
+"""O1 autocast: a trace-time dtype policy with a function registry.
+
+Reference: apex O1 monkey-patches ~200 functions on the torch namespace via
+white/black/promote lists (``apex/amp/amp.py:68-177``, cast lists in
+``apex/amp/lists/*.py``) and exposes ``register_half_function`` etc.
+(``apex/amp/amp.py:26-66``). JAX has no mutable op namespace that can be
+patched safely under tracing, so the same *capability* is provided as:
+
+- an ``autocast(...)`` context manager setting a trace-time policy
+  (ContextVar — safe under nested jit tracing since tracing is
+  single-threaded per trace);
+- decorators ``half_function`` / ``float_function`` / ``promote_function``
+  that wrap any callable with the corresponding input-cast behavior,
+  active only while a policy is enabled;
+- registration helpers mirroring the apex module API
+  (``amp.register_half_function(module, name)``), which *rebind the
+  attribute on the owning module object* — the JAX-safe equivalent of the
+  reference's patching, applied to user/apex_tpu modules (never to jax
+  itself);
+- the weight-cast **cache** semantics of apex (``apex/amp/utils.py:97-158``)
+  are unnecessary: under jit, casting the same param twice is CSE'd by XLA.
+
+All apex_tpu fused layers consult this policy, so O1 gives per-op mixed
+precision across the library out of the box.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+class CastPolicy:
+    def __init__(self, enabled: bool, half_dtype=jnp.bfloat16):
+        self.enabled = enabled
+        self.half_dtype = half_dtype
+
+
+_policy: contextvars.ContextVar[CastPolicy | None] = contextvars.ContextVar(
+    "apex_tpu_amp_policy", default=None
+)
+
+
+def current_policy() -> CastPolicy | None:
+    return _policy.get()
+
+
+def autocast_enabled() -> bool:
+    p = _policy.get()
+    return p is not None and p.enabled
+
+
+@contextlib.contextmanager
+def autocast(enabled: bool = True, dtype=jnp.bfloat16):
+    """Enable the O1 cast policy for ops traced inside the context.
+
+    Analog of entering an amp-O1-initialized region; also the analog of
+    ``amp.disable_casts`` (``apex/amp/handle.py:156-164``) when called with
+    ``enabled=False``.
+    """
+    token = _policy.set(CastPolicy(enabled, dtype))
+    try:
+        yield
+    finally:
+        _policy.reset(token)
+
+
+disable_casts = functools.partial(autocast, False)
+
+
+def _cast_tree(args: Any, dtype) -> Any:
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if hasattr(x, "dtype") and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+        else x,
+        args,
+    )
+
+
+def half_function(fn: Callable) -> Callable:
+    """Run ``fn`` in half precision when autocast is active.
+
+    Analog of ``apex.amp.half_function`` (``apex/amp/amp.py:56-58``);
+    matmul-class ops (dense, conv, attention, MLP) are registered with this.
+    """
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        p = _policy.get()
+        if p is not None and p.enabled:
+            args = _cast_tree(args, p.half_dtype)
+            kwargs = _cast_tree(kwargs, p.half_dtype)
+        return fn(*args, **kwargs)
+
+    wrapper.__amp_cast__ = "half"
+    return wrapper
+
+
+def float_function(fn: Callable) -> Callable:
+    """Run ``fn`` in fp32 when autocast is active (softmax/log/loss class).
+
+    Analog of ``apex.amp.float_function`` (``apex/amp/amp.py:60-62``).
+    """
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        p = _policy.get()
+        if p is not None and p.enabled:
+            args = _cast_tree(args, jnp.float32)
+            kwargs = _cast_tree(kwargs, jnp.float32)
+        return fn(*args, **kwargs)
+
+    wrapper.__amp_cast__ = "float"
+    return wrapper
+
+
+def promote_function(fn: Callable) -> Callable:
+    """Promote all floating args to the widest present dtype.
+
+    Analog of ``apex.amp.promote_function`` (``apex/amp/amp.py:64-66``,
+    promotion logic ``apex/amp/wrap.py:76-119``).
+    """
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        p = _policy.get()
+        if p is not None and p.enabled:
+            leaves = [
+                x for x in jax.tree.leaves((args, kwargs))
+                if hasattr(x, "dtype") and jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+            ]
+            if leaves:
+                widest = functools.reduce(jnp.promote_types, [x.dtype for x in leaves])
+                args = _cast_tree(args, widest)
+                kwargs = _cast_tree(kwargs, widest)
+        return fn(*args, **kwargs)
+
+    wrapper.__amp_cast__ = "promote"
+    return wrapper
+
+
+def _register(module, name, deco):
+    fn = getattr(module, name)
+    if getattr(fn, "__amp_cast__", None) is None:
+        setattr(module, name, deco(fn))
+
+
+def register_half_function(module, name: str):
+    """``apex.amp.register_half_function`` parity (``apex/amp/amp.py:26-35``)."""
+    _register(module, name, half_function)
+
+
+def register_float_function(module, name: str):
+    _register(module, name, float_function)
+
+
+def register_promote_function(module, name: str):
+    _register(module, name, promote_function)
+
+
+# Functions banned under autocast for numerical-safety, mirroring apex's
+# treatment of fp16 binary_cross_entropy (``apex/amp/lists/functional_overrides.py:63-77``).
+def err_if_autocast(fn: Callable, name: str, hint: str) -> Callable:
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if autocast_enabled():
+            leaves = [x for x in jax.tree.leaves((args, kwargs)) if hasattr(x, "dtype")]
+            if any(jnp.asarray(x).dtype in (jnp.float16, jnp.bfloat16) for x in leaves):
+                raise NotImplementedError(
+                    f"amp does not work out-of-the-box with `{name}`; {hint}"
+                )
+        return fn(*args, **kwargs)
+
+    return wrapper

@@ -1,0 +1,151 @@
+"""Opt-level properties: O0–O3 precision policies.
+
+Reference: ``apex/amp/frontend.py:7-191`` — a ``Properties`` object with
+per-property consistency validation in ``__setattr__`` plus four canned opt
+levels, overridable by explicit kwargs (``frontend.py:336-356``).
+
+TPU deltas (documented, deliberate):
+- the default half type is **bfloat16** (no loss scaling needed for range,
+  so bf16 opt levels default ``loss_scale=1.0``); ``float16`` is fully
+  supported for parity and then defaults to dynamic scaling like apex.
+- ``patch_torch_functions`` becomes ``cast_ops`` — O1 per-op casting is a
+  trace-time dtype policy applied through the ``apex_tpu.amp.policy``
+  registry, not namespace monkey-patching (JAX has no safely patchable op
+  namespace; see SURVEY §7 hard parts).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Properties:
+    """Mutable options bag with mutual-consistency handling.
+
+    Mirrors ``apex/amp/frontend.py:7-97``: options may be set before or
+    after an opt level is chosen; setting an opt level stamps its defaults
+    over unset options, and explicit user overrides win.
+    """
+
+    def __init__(self):
+        self.options = {
+            "enabled": False,
+            "opt_level": None,
+            "cast_model_type": None,       # dtype params are cast to (O2/O3)
+            "cast_ops": False,             # O1 per-op autocast policy
+            "cast_model_outputs": None,    # force outputs to this dtype
+            "keep_batchnorm_fp32": None,   # exempt norm params from the cast
+            "master_weights": None,        # keep fp32 master params in optimizer
+            "loss_scale": 1.0,             # float or "dynamic"
+            "half_dtype": jnp.bfloat16,    # what "half" means on this device
+        }
+
+    def _update_options_dict(self, new_options: dict):
+        for k, v in new_options.items():
+            if k in self.options:
+                self.options[k] = v
+            else:
+                raise ValueError(f"Tried to set unexpected option {k}")
+
+    def __getattr__(self, name):
+        if "options" in self.__dict__ and name in self.__dict__["options"]:
+            return self.options[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if "options" in self.__dict__ and name in self.options:
+            if name == "loss_scale" and value != "dynamic" and value is not None:
+                value = float(value)
+            if name == "keep_batchnorm_fp32" and isinstance(value, str):
+                # apex accepts the strings "True"/"False" here
+                # (apex/amp/frontend.py:269-278)
+                if value not in ("True", "False"):
+                    raise ValueError(f"keep_batchnorm_fp32 string must be 'True'/'False', got {value}")
+                value = value == "True"
+            self.options[name] = value
+        else:
+            super().__setattr__(name, value)
+
+
+class O3:
+    """Pure half. ``cast_model_type=half, master_weights=False, loss_scale=1``.
+
+    Reference: ``apex/amp/frontend.py:100-116``.
+    """
+
+    brief = "O3: Pure half precision (speed-of-light baseline)."
+
+    def __call__(self, properties: Properties) -> Properties:
+        properties.enabled = True
+        properties.opt_level = "O3"
+        properties.cast_model_type = properties.half_dtype
+        properties.cast_ops = False
+        properties.keep_batchnorm_fp32 = False
+        properties.master_weights = False
+        properties.loss_scale = 1.0
+        return properties
+
+
+class O2:
+    """Half model + fp32 batchnorm + fp32 master weights + loss scaling.
+
+    Reference: ``apex/amp/frontend.py:118-143``. With bf16 the default
+    ``loss_scale`` is 1.0 (bf16 shares fp32 exponent range); with fp16 it
+    is "dynamic" exactly like apex.
+    """
+
+    brief = "O2: 'Almost half' — half model, fp32 batchnorm and master weights."
+
+    def __call__(self, properties: Properties) -> Properties:
+        properties.enabled = True
+        properties.opt_level = "O2"
+        properties.cast_model_type = properties.half_dtype
+        properties.cast_ops = False
+        properties.keep_batchnorm_fp32 = True
+        properties.master_weights = True
+        properties.loss_scale = (
+            "dynamic" if properties.half_dtype == jnp.float16 else 1.0
+        )
+        return properties
+
+
+class O1:
+    """Per-op cast policy; fp32 weights; dynamic scaling for fp16.
+
+    Reference: ``apex/amp/frontend.py:145-167`` — instead of patching the
+    torch namespace, O1 here activates the trace-time autocast policy
+    consulted by apex_tpu ops and ``half_function``-registered functions.
+    """
+
+    brief = "O1: per-op mixed precision via the autocast policy registry."
+
+    def __call__(self, properties: Properties) -> Properties:
+        properties.enabled = True
+        properties.opt_level = "O1"
+        properties.cast_model_type = None
+        properties.cast_ops = True
+        properties.keep_batchnorm_fp32 = None
+        properties.master_weights = None
+        properties.loss_scale = (
+            "dynamic" if properties.half_dtype == jnp.float16 else 1.0
+        )
+        return properties
+
+
+class O0:
+    """Pure fp32 baseline. Reference: ``apex/amp/frontend.py:169-191``."""
+
+    brief = "O0: Pure fp32 (accuracy baseline)."
+
+    def __call__(self, properties: Properties) -> Properties:
+        properties.enabled = True
+        properties.opt_level = "O0"
+        properties.cast_model_type = jnp.float32
+        properties.cast_ops = False
+        properties.keep_batchnorm_fp32 = None
+        properties.master_weights = False
+        properties.loss_scale = 1.0
+        return properties
+
+
+opt_levels = {"O3": O3(), "O2": O2(), "O1": O1(), "O0": O0()}

@@ -1,0 +1,9 @@
+"""apex_tpu.contrib — specialized fused components.
+
+Reference: ``apex/contrib`` (multihead attention, FMHA, xentropy, group
+BN, transducer, sparsity, bottleneck, distributed optimizers). Each
+subpackage here is the TPU-native counterpart; see SURVEY §2.2 for the
+kernel-by-kernel mapping.
+"""
+
+from apex_tpu.contrib import xentropy  # noqa: F401

@@ -1,0 +1,11 @@
+"""apex_tpu.contrib.xentropy — fused softmax cross entropy.
+
+Reference: ``apex/contrib/xentropy/__init__.py`` exposing
+``SoftmaxCrossEntropyLoss`` backed by ``xentropy_cuda``
+(``apex/contrib/xentropy/softmax_xentropy.py:4-31``).
+"""
+
+from apex_tpu.ops.xentropy import (  # noqa: F401
+    SoftmaxCrossEntropyLoss,
+    softmax_cross_entropy_with_smoothing,
+)

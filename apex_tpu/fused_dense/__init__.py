@@ -1,0 +1,10 @@
+"""apex_tpu.fused_dense — FusedDense / FusedDenseGeluDense modules.
+
+Reference: ``apex/fused_dense/fused_dense.py:6-85``.
+"""
+
+from apex_tpu.fused_dense.fused_dense import (  # noqa: F401
+    FusedDense,
+    FusedDenseGeluDense,
+)
+from apex_tpu.ops.dense import linear_bias, linear_gelu_linear  # noqa: F401

@@ -1,0 +1,55 @@
+"""FusedDense / FusedDenseGeluDense flax modules.
+
+Reference: ``apex/fused_dense/fused_dense.py:56-85`` — ``FusedDense(in,
+out)`` is a Linear whose bias is fused into the GEMM epilogue;
+``FusedDenseGeluDense(in, intermediate, out)`` fuses
+dense→GELU→dense. Both are amp half-functions (:50-52).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.ops.dense import linear_bias, linear_gelu_linear
+
+
+class FusedDense(nn.Module):
+    in_features: int
+    out_features: int
+    use_bias: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        # torch layout [out, in] for checkpoint/API parity with the reference
+        weight = self.param(
+            "weight", nn.initializers.lecun_normal(),
+            (self.out_features, self.in_features), self.param_dtype)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.out_features,), self.param_dtype)
+        else:
+            bias = jnp.zeros((self.out_features,), self.param_dtype)
+        return linear_bias(x, weight.astype(x.dtype), bias.astype(x.dtype))
+
+
+class FusedDenseGeluDense(nn.Module):
+    in_features: int
+    intermediate_features: int
+    out_features: int
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        w1 = self.param("weight1", nn.initializers.lecun_normal(),
+                        (self.intermediate_features, self.in_features), self.param_dtype)
+        b1 = self.param("bias1", nn.initializers.zeros,
+                        (self.intermediate_features,), self.param_dtype)
+        w2 = self.param("weight2", nn.initializers.lecun_normal(),
+                        (self.out_features, self.intermediate_features), self.param_dtype)
+        b2 = self.param("bias2", nn.initializers.zeros,
+                        (self.out_features,), self.param_dtype)
+        return linear_gelu_linear(
+            x, w1.astype(x.dtype), b1.astype(x.dtype),
+            w2.astype(x.dtype), b2.astype(x.dtype))

@@ -1,0 +1,7 @@
+"""apex_tpu.mlp — fused multi-layer MLP module.
+
+Reference: ``apex/mlp/mlp.py:8-79``.
+"""
+
+from apex_tpu.mlp.mlp import MLP  # noqa: F401
+from apex_tpu.ops.mlp import mlp_forward  # noqa: F401

@@ -1,0 +1,40 @@
+"""MLP module: N fused dense+bias+activation layers.
+
+Reference: ``apex/mlp/mlp.py:8-79`` — ``MLP(mlp_sizes, bias=True,
+relu=True)`` runs every layer inside one fused autograd Function
+(``MlpFunction``). Here ``apex_tpu.ops.mlp_forward`` is the single fused
+region; activation choices mirror the kernel's none/relu/sigmoid
+(``csrc/mlp.cpp``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.ops.mlp import mlp_forward
+
+
+class MLP(nn.Module):
+    mlp_sizes: Sequence[int]          # [in, hidden..., out]
+    use_bias: bool = True
+    activation: str = "relu"
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        weights, biases = [], []
+        for i in range(len(self.mlp_sizes) - 1):
+            fan_in, fan_out = self.mlp_sizes[i], self.mlp_sizes[i + 1]
+            w = self.param(f"weight_{i}", nn.initializers.lecun_normal(),
+                           (fan_out, fan_in), self.param_dtype)
+            weights.append(w.astype(x.dtype))
+            if self.use_bias:
+                b = self.param(f"bias_{i}", nn.initializers.zeros,
+                               (fan_out,), self.param_dtype)
+            else:
+                b = jnp.zeros((fan_out,), self.param_dtype)
+            biases.append(b.astype(x.dtype))
+        return mlp_forward(x, weights, biases, self.activation)

@@ -1,0 +1,12 @@
+"""apex_tpu.models — reference models for the example/benchmark configs.
+
+The reference ships no model zoo; its examples train torchvision models
+(``examples/imagenet/main_amp.py``) and a simple net
+(``examples/simple/``). These flax implementations fill the same role for
+the BASELINE.md configs: MLP (config 1), ResNet-50 (configs 2–3),
+BERT-style encoder (config 4), GPT (config 5).
+"""
+
+from apex_tpu.models.mlp import SimpleMLP  # noqa: F401
+from apex_tpu.models.resnet import ResNet, ResNet18, ResNet50, ResNet101  # noqa: F401
+from apex_tpu.models.gpt import GPT, GPTConfig  # noqa: F401

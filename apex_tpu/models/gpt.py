@@ -1,0 +1,137 @@
+"""GPT: Megatron-style tensor-parallel transformer LM.
+
+Role: the ``apex.transformer`` GPT test model (BASELINE config 5;
+reference builds it from Column/RowParallelLinear + fused softmax in its
+mpu tests, ``apex/transformer/tensor_parallel/tests/``). Built from
+apex_tpu TP layers so the same module runs at tp=1 (plain dense) and
+tp=k inside ``shard_map`` — and under GSPMD with sharding constraints.
+
+TPU notes: attention scores/softmax run through FusedScaleMaskSoftmax
+(fp32 accumulation), matmuls carry ``preferred_element_type=float32`` so
+bf16 weights still accumulate in fp32 on the MXU, and activation
+checkpointing is a flag away (``remat_blocks``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    vocab_parallel_cross_entropy)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304
+    max_seq_len: int = 1024
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden_size: Optional[int] = None   # default 4*hidden
+    dtype: Any = jnp.bfloat16
+    remat_blocks: bool = False
+
+    @property
+    def ffn(self):
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+
+class ParallelSelfAttention(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = cfg.hidden_size
+        tp = ps._axis_size(ps.TENSOR_AXIS)
+        heads_per = cfg.num_heads // tp
+        head_dim = h // cfg.num_heads
+
+        qkv = ColumnParallelLinear(
+            input_size=h, output_size=3 * h, gather_output=False,
+            name="qkv")(x)                       # [b, s, 3h/tp]
+        b, s, _ = qkv.shape
+        qkv = qkv.reshape(b, s, heads_per, 3 * head_dim)
+        q, k, v = jnp.split(qkv, 3, axis=-1)      # [b, s, hp, d]
+
+        scores = jnp.einsum("bshd,bthd->bhst", q, k,
+                            preferred_element_type=jnp.float32)
+        softmax = FusedScaleMaskSoftmax(
+            input_in_bf16=cfg.dtype == jnp.bfloat16,
+            attn_mask_type=AttnMaskType.causal,
+            scale=head_dim ** -0.5,
+        )
+        probs = softmax(scores.astype(cfg.dtype))
+        ctx = jnp.einsum("bhst,bthd->bshd", probs.astype(cfg.dtype), v,
+                         preferred_element_type=jnp.float32).astype(cfg.dtype)
+        ctx = ctx.reshape(b, s, heads_per * head_dim)
+        return RowParallelLinear(
+            input_size=h, output_size=h, input_is_parallel=True,
+            name="proj")(ctx)
+
+
+class ParallelMLP(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        y = ColumnParallelLinear(
+            input_size=cfg.hidden_size, output_size=cfg.ffn,
+            gather_output=False, name="fc1")(x)
+        y = jax.nn.gelu(y.astype(jnp.float32), approximate=True).astype(x.dtype)
+        return RowParallelLinear(
+            input_size=cfg.ffn, output_size=cfg.hidden_size,
+            input_is_parallel=True, name="fc2")(y)
+
+
+class GPTBlock(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln1")(
+            x.astype(jnp.float32)).astype(cfg.dtype)
+        x = x + ParallelSelfAttention(cfg, name="attn")(h)
+        h = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln2")(
+            x.astype(jnp.float32)).astype(cfg.dtype)
+        return x + ParallelMLP(cfg, name="mlp")(h)
+
+
+class GPT(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, ids):
+        cfg = self.cfg
+        wte = VocabParallelEmbedding(
+            num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
+            name="wte")
+        x = wte(ids).astype(cfg.dtype)
+        pos = self.param("wpe", nn.initializers.normal(0.02),
+                         (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
+        x = x + pos[None, :ids.shape[1]].astype(cfg.dtype)
+        block_cls = nn.remat(GPTBlock) if cfg.remat_blocks else GPTBlock
+        for i in range(cfg.num_layers):
+            x = block_cls(cfg, name=f"block_{i}")(x)
+        x = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln_f")(
+            x.astype(jnp.float32)).astype(cfg.dtype)
+        # vocab-parallel logits, tied to the embedding shard
+        logits = wte.attend(x)
+        return logits  # [b, s, V/tp] (full V at tp=1)
+
+    def loss(self, variables, ids, labels):
+        logits = self.apply(variables, ids)
+        losses = vocab_parallel_cross_entropy(logits, labels)
+        return jnp.mean(losses)

@@ -1,0 +1,21 @@
+"""Simple MLP model for the ``examples/simple`` analog (BASELINE config 1)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+
+from apex_tpu.mlp import MLP
+
+
+class SimpleMLP(nn.Module):
+    """MLP classifier built on the fused MLP block."""
+
+    features: Sequence[int] = (784, 512, 256, 10)
+    activation: str = "relu"
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        return MLP(mlp_sizes=tuple(self.features), activation=self.activation)(x)

@@ -1,0 +1,132 @@
+"""ResNet for TPU: NHWC, bf16-friendly, pluggable norm (BN / SyncBN).
+
+Role: the torchvision ResNet-50 used by the reference's imagenet example
+and L1 convergence tests (``examples/imagenet/main_amp.py``,
+``tests/L1/common/main_amp.py``) — reimplemented flax-native:
+
+- NHWC layout (TPU conv layout; the reference gets this via
+  ``--channels-last`` / memory_format tricks);
+- ``norm`` factory argument so ``apex_tpu.parallel.SyncBatchNorm`` (or the
+  grouped variant) can be dropped in — the functional analog of
+  ``convert_syncbn_model`` (``apex/parallel/__init__.py:21``);
+- compute dtype is the input dtype: amp O2 casts inputs to bf16 and keeps
+  norm params fp32, matching apex's keep_batchnorm_fp32.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class _BNWrap(nn.Module):
+    """Default norm: flax BatchNorm in fp32 (params + stats), NHWC."""
+
+    num_features: int
+    momentum: float = 0.9
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        bn = nn.BatchNorm(
+            use_running_average=use_running_average,
+            momentum=self.momentum, epsilon=1e-5,
+            dtype=jnp.float32, param_dtype=jnp.float32)
+        return bn(x.astype(jnp.float32)).astype(x.dtype)
+
+
+class Bottleneck(nn.Module):
+    """1x1-3x3-1x1 bottleneck block (cf. the fused
+    ``apex/contrib/bottleneck/bottleneck.py:52`` Bottleneck — fusion on TPU
+    is XLA's job, so this is the plain graph XLA fuses)."""
+
+    filters: int
+    strides: int = 1
+    expansion: int = 4
+    norm: Callable = _BNWrap
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                                 param_dtype=jnp.float32)
+        needs_proj = x.shape[-1] != self.filters * self.expansion or self.strides != 1
+        residual = x
+        y = conv(self.filters, (1, 1))(x)
+        y = self.norm(num_features=self.filters)(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                 padding=[(1, 1), (1, 1)])(y)
+        y = self.norm(num_features=self.filters)(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = conv(self.filters * self.expansion, (1, 1))(y)
+        y = self.norm(num_features=self.filters * self.expansion)(
+            y, use_running_average=not train)
+        if needs_proj:
+            residual = conv(self.filters * self.expansion, (1, 1),
+                            strides=(self.strides, self.strides))(x)
+            residual = self.norm(num_features=self.filters * self.expansion)(
+                residual, use_running_average=not train)
+        return nn.relu(y + residual)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    expansion: int = 1
+    norm: Callable = _BNWrap
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                                 param_dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                 padding=[(1, 1), (1, 1)])(x)
+        y = self.norm(num_features=self.filters)(y, use_running_average=not train)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), padding=[(1, 1), (1, 1)])(y)
+        y = self.norm(num_features=self.filters)(y, use_running_average=not train)
+        if x.shape[-1] != self.filters or self.strides != 1:
+            residual = conv(self.filters, (1, 1),
+                            strides=(self.strides, self.strides))(x)
+            residual = self.norm(num_features=self.filters)(
+                residual, use_running_average=not train)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: type = Bottleneck
+    num_classes: int = 1000
+    num_filters: int = 64
+    norm: Callable = _BNWrap
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.num_filters, (7, 7), strides=(2, 2),
+                    padding=[(3, 3), (3, 3)], use_bias=False,
+                    dtype=self.dtype, param_dtype=jnp.float32)(x)
+        x = self.norm(num_features=self.num_filters)(x, use_running_average=not train)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(
+                    filters=self.num_filters * 2 ** i, strides=strides,
+                    norm=self.norm, dtype=self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32)(x.astype(jnp.float32))
+        return x
+
+
+ResNet18 = functools.partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
+ResNet50 = functools.partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck)
+ResNet101 = functools.partial(ResNet, stage_sizes=(3, 4, 23, 3), block_cls=Bottleneck)

@@ -1,0 +1,22 @@
+"""Multi-tensor apply: one fused update across a whole list of tensors.
+
+Reference: ``apex/multi_tensor_apply/multi_tensor_apply.py:3-30`` dispatches
+to CUDA kernels (``csrc/multi_tensor_apply.cuh``) that chunk a list of
+tensors into one kernel launch with a global ``noop_flag`` for inf/nan.
+
+TPU design: there is no kernel-launch overhead to amortize under XLA — a
+single ``jit`` region already fuses elementwise work — so the fusion axis
+here is *array granularity*: ops take whole tensor lists, compute on either
+the per-leaf or a packed flat-buffer representation, and return a device-
+resident ``found_inf`` flag instead of mutating a noop buffer. Overflow
+handling stays on device (no D2H sync; cf. apex's single sync point at
+``apex/amp/scaler.py:197-200``).
+"""
+
+from apex_tpu.multi_tensor_apply.functional import (  # noqa: F401
+    multi_tensor_scale,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_applier,
+    MultiTensorApply,
+)

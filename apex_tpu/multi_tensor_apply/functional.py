@@ -1,0 +1,94 @@
+"""Functional multi-tensor ops (scale / axpby / l2norm).
+
+Each mirrors an ``amp_C`` kernel (``csrc/amp_C_frontend.cpp:122-145``) but
+is a pure function: outputs are returned, and the overflow flag is a
+returned boolean scalar (True = overflow observed) rather than a mutated
+GPU buffer. All are jit-safe and fuse into surrounding computation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _found_inf(tensors: Sequence[jax.Array]) -> jax.Array:
+    if not tensors:
+        return jnp.asarray(False)
+    return ~jnp.stack([jnp.all(jnp.isfinite(t)) for t in tensors]).all()
+
+
+def multi_tensor_scale(srcs: Sequence[jax.Array], scale, out_dtype=None):
+    """``dst = src * scale`` across a tensor list.
+
+    Reference: ``csrc/multi_tensor_scale_kernel.cu`` — used for grad
+    unscaling (``apex/amp/scaler.py:114``) and fp32->fp16 master->model
+    param copies (``apex/amp/_process_optimizer.py:14-25``).
+
+    Returns ``(outs, found_inf)`` where ``found_inf`` reflects inf/nan in
+    the *source* tensors (matching the kernel's check-before-write).
+    """
+    scale = jnp.asarray(scale, jnp.float32)
+    outs = []
+    for s in srcs:
+        o = s.astype(jnp.float32) * scale
+        outs.append(o.astype(out_dtype or s.dtype))
+    return outs, _found_inf(srcs)
+
+
+def multi_tensor_axpby(xs: Sequence[jax.Array], ys: Sequence[jax.Array], a, b, out_dtype=None):
+    """``out = a*x + b*y`` across tensor lists.
+
+    Reference: ``csrc/multi_tensor_axpby_kernel.cu`` — used for gradient
+    accumulation across unscale calls (``apex/amp/scaler.py:152-195``).
+    Returns ``(outs, found_inf)``; the flag checks both inputs.
+    """
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    outs = []
+    for x, y in zip(xs, ys):
+        o = a * x.astype(jnp.float32) + b * y.astype(jnp.float32)
+        outs.append(o.astype(out_dtype or y.dtype))
+    return outs, _found_inf(list(xs) + list(ys))
+
+
+def multi_tensor_l2norm(tensors: Sequence[jax.Array], per_tensor: bool = False):
+    """Global (and optionally per-tensor) L2 norm over a tensor list.
+
+    Reference: ``csrc/multi_tensor_l2norm_kernel.cu`` — used by FusedLAMB's
+    phase 1 (``apex/optimizers/fused_lamb.py:124-133``) and grad clipping.
+    """
+    if not tensors:
+        z = jnp.zeros((), jnp.float32)
+        return (z, jnp.zeros((0,), jnp.float32)) if per_tensor else (z, None)
+    sq = jnp.stack([jnp.sum(jnp.square(t.astype(jnp.float32))) for t in tensors])
+    norm = jnp.sqrt(jnp.sum(sq))
+    if per_tensor:
+        return norm, jnp.sqrt(sq)
+    return norm, None
+
+
+def multi_tensor_applier(op, tensor_lists, *args, **kwargs):
+    """Apply ``op`` across tensor lists; parity shim for the apex call shape
+    (``apex/multi_tensor_apply/multi_tensor_apply.py:24-30``) minus the
+    mutable ``noop_flag`` argument, which is returned instead."""
+    return op(*tensor_lists, *args, **kwargs) if isinstance(tensor_lists, (list, tuple)) and tensor_lists and isinstance(tensor_lists[0], (list, tuple)) else op(tensor_lists, *args, **kwargs)
+
+
+class MultiTensorApply:
+    """API-parity dispatcher. Always available on TPU (no extension build).
+
+    Reference: ``apex/multi_tensor_apply/multi_tensor_apply.py:3-30`` —
+    ``available`` gated every fused path in apex; here it is always True.
+    """
+
+    available: bool = True
+    warned: bool = False
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size  # kept for API parity; XLA chooses tiling
+
+    def __call__(self, op, noop_flag_or_lists, *args, **kwargs):
+        return multi_tensor_applier(op, noop_flag_or_lists, *args, **kwargs)

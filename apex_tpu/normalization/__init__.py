@@ -1,0 +1,12 @@
+"""apex_tpu.normalization — FusedLayerNorm / FusedRMSNorm modules.
+
+Reference: ``apex/normalization/__init__.py`` (FusedLayerNorm,
+MixedFusedLayerNorm).
+"""
+
+from apex_tpu.normalization.fused_layer_norm import (  # noqa: F401
+    FusedLayerNorm,
+    MixedFusedLayerNorm,
+    FusedRMSNorm,
+    MixedFusedRMSNorm,
+)

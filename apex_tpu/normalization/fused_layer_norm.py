@@ -1,0 +1,86 @@
+"""FusedLayerNorm / MixedFusedLayerNorm flax modules.
+
+Reference: ``apex/normalization/fused_layer_norm.py:102-219`` —
+``FusedLayerNorm`` mirrors ``torch.nn.LayerNorm`` backed by the fused
+kernel (CPU fallback to unfused math, :147-151 — here the jnp path *is*
+the fallback and the Pallas path the fast one, chosen inside the op);
+``MixedFusedLayerNorm`` (:202) keeps params in the input dtype so output
+dtype == param dtype (Megatron-compatible).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.ops.layer_norm import (
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+)
+
+
+def _as_shape(normalized_shape) -> tuple[int, ...]:
+    if isinstance(normalized_shape, int):
+        return (normalized_shape,)
+    return tuple(normalized_shape)
+
+
+class FusedLayerNorm(nn.Module):
+    normalized_shape: Sequence[int] | int
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _as_shape(self.normalized_shape)
+        if self.elementwise_affine:
+            weight = self.param(
+                "weight", nn.initializers.ones, shape, self.param_dtype)
+            bias = self.param(
+                "bias", nn.initializers.zeros, shape, self.param_dtype)
+            return fused_layer_norm_affine(x, weight, bias, shape, self.eps)
+        return fused_layer_norm(x, shape, self.eps)
+
+
+class MixedFusedLayerNorm(FusedLayerNorm):
+    """Params stored in (and output cast to) the compute dtype — the
+    ``memory_efficient``/mixed-dtype Megatron variant
+    (``apex/normalization/fused_layer_norm.py:202-219``)."""
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _as_shape(self.normalized_shape)
+        weight = self.param("weight", nn.initializers.ones, shape, self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, shape, self.param_dtype)
+        return fused_layer_norm_affine(
+            x, weight.astype(x.dtype), bias.astype(x.dtype), shape, self.eps)
+
+
+class FusedRMSNorm(nn.Module):
+    """RMSNorm module (upstream apex ``FusedRMSNorm`` API parity)."""
+
+    normalized_shape: Sequence[int] | int
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _as_shape(self.normalized_shape)
+        if self.elementwise_affine:
+            weight = self.param("weight", nn.initializers.ones, shape, self.param_dtype)
+            return fused_rms_norm_affine(x, weight, shape, self.eps)
+        return fused_rms_norm(x, shape, self.eps)
+
+
+class MixedFusedRMSNorm(FusedRMSNorm):
+    @nn.compact
+    def __call__(self, x):
+        shape = _as_shape(self.normalized_shape)
+        weight = self.param("weight", nn.initializers.ones, shape, self.param_dtype)
+        return fused_rms_norm_affine(x, weight.astype(x.dtype), shape, self.eps)

@@ -1,0 +1,21 @@
+"""apex_tpu.ops — functional fused ops (the ``csrc/`` equivalents).
+
+Each op has a reference jnp implementation (always available; XLA already
+fuses these into few kernels) and, where it pays, a Pallas TPU kernel
+selected automatically on TPU backends. Ops register with the amp O1
+policy (half/float lists mirroring ``apex/amp/lists/``).
+"""
+
+from apex_tpu.ops.layer_norm import (  # noqa: F401
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+)
+from apex_tpu.ops.dense import linear_bias, linear_gelu_linear  # noqa: F401
+from apex_tpu.ops.softmax import (  # noqa: F401
+    scaled_masked_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.ops.xentropy import softmax_cross_entropy_with_smoothing  # noqa: F401
+from apex_tpu.ops.mlp import mlp_forward  # noqa: F401

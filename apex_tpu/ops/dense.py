@@ -1,0 +1,46 @@
+"""Fused dense (GEMM+bias) and dense→GELU→dense.
+
+Reference: ``csrc/fused_dense_cuda.cu`` — cuBLASLt epilogue fusion of bias
+(+GELU) into the GEMM (``CUBLASLT_EPILOGUE`` setup :176-188), exposed as
+``linear_bias_forward`` / ``linear_gelu_linear_forward``
+(``csrc/fused_dense.cpp:187-190``).
+
+On TPU, XLA fuses bias/GELU epilogues into the MXU matmul natively, so the
+fused op is simply a jit-friendly composition kept in one function (and
+registered as an amp ``half_function`` like the reference registers its
+modules — ``apex/fused_dense/fused_dense.py:50-52``). Weights use the
+torch layout ``[out_features, in_features]`` for API parity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.policy import half_function
+
+
+def _gelu(x):
+    # exact (erf) GELU, matching torch's default used by the reference kernels
+    return 0.5 * x * (1.0 + jax.lax.erf(x / jnp.sqrt(2.0).astype(x.dtype)))
+
+
+@half_function
+def linear_bias(x, weight, bias):
+    """``y = x @ W^T + b`` in one MXU-fused op
+    (``fused_dense_cuda.cu linear_bias_forward``)."""
+    y = jax.lax.dot_general(
+        x, weight,
+        dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return (y + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+@half_function
+def linear_gelu_linear(x, weight1, bias1, weight2, bias2):
+    """dense→GELU→dense in one fused region
+    (``fused_dense_cuda.cu linear_gelu_linear_forward``)."""
+    h = linear_bias(x, weight1, bias1)
+    h = _gelu(h.astype(jnp.float32)).astype(h.dtype)
+    return linear_bias(h, weight2, bias2)

@@ -1,0 +1,167 @@
+"""Fused LayerNorm / RMSNorm with explicit custom VJP.
+
+Reference: ``csrc/layer_norm_cuda_kernel.cu`` (warp-shuffle Welford; saves
+``(mean, invvar)`` for backward — ``csrc/layer_norm_cuda.cpp:260-265``)
+and the ``--fast_layer_norm`` contrib variant
+(``apex/contrib/csrc/layer_norm/ln_fwd_cuda_kernel.cu``), both folded into
+this one implementation per SURVEY §7.3.
+
+Math is fp32 regardless of input dtype (matching the kernels' float
+accumulators); the residuals saved for backward are ``(x, mean, invvar)``
+like the reference, so the backward recomputes xhat instead of storing it.
+On TPU the jnp forms fuse into tight VPU loops.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _norm_axes(x, normalized_shape):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_axes = len(normalized_shape)
+    if tuple(x.shape[-n_axes:]) != tuple(normalized_shape):
+        raise ValueError(
+            f"normalized_shape {normalized_shape} does not match input tail {x.shape[-n_axes:]}")
+    return tuple(range(x.ndim - n_axes, x.ndim))
+
+
+def _stats(x32, axes):
+    mean = jnp.mean(x32, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=axes, keepdims=True)
+    return mean, var
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_layer_norm_affine(x, weight, bias, normalized_shape, eps=1e-5):
+    """LayerNorm with affine params; output dtype follows ``weight`` dtype
+    (this single function also covers the reference's
+    ``forward_affine_mixed_dtypes`` — ``csrc/layer_norm_cuda.cpp:264``:
+    bf16 input with fp32 params yields fp32 out in "mixed" mode, while
+    ``MixedFusedLayerNorm`` passes bf16 params to get bf16 out)."""
+    y, _, _ = _ln_fwd_affine(x, weight, bias, normalized_shape, eps)
+    return y
+
+
+def _ln_fwd_affine(x, weight, bias, normalized_shape, eps):
+    axes = _norm_axes(x, normalized_shape)
+    x32 = x.astype(jnp.float32)
+    mean, var = _stats(x32, axes)
+    invvar = jax.lax.rsqrt(var + eps)
+    xhat = (x32 - mean) * invvar
+    y = xhat * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(weight.dtype), mean, invvar
+
+
+def _ln_fwd_affine_vjp(x, weight, bias, normalized_shape, eps):
+    y, mean, invvar = _ln_fwd_affine(x, weight, bias, normalized_shape, eps)
+    return y, (x, weight, mean, invvar)
+
+
+def _ln_bwd_affine(normalized_shape, eps, res, dy):
+    x, weight, mean, invvar = res
+    axes = _norm_axes(x, normalized_shape)
+    x32 = x.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    xhat = (x32 - mean) * invvar
+    w32 = weight.astype(jnp.float32)
+    dxhat = dy32 * w32
+    n = np.prod([x.shape[a] for a in axes])
+    # dx = invvar/N * (N*dxhat - sum(dxhat) - xhat * sum(dxhat*xhat))
+    s1 = jnp.sum(dxhat, axis=axes, keepdims=True)
+    s2 = jnp.sum(dxhat * xhat, axis=axes, keepdims=True)
+    dx = (invvar / n) * (n * dxhat - s1 - xhat * s2)
+    red_axes = tuple(range(x.ndim - len(axes)))
+    dw = jnp.sum(dy32 * xhat, axis=red_axes)
+    db = jnp.sum(dy32, axis=red_axes)
+    return dx.astype(x.dtype), dw.astype(weight.dtype), db.astype(weight.dtype)
+
+
+fused_layer_norm_affine.defvjp(_ln_fwd_affine_vjp, _ln_bwd_affine)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def fused_layer_norm(x, normalized_shape, eps=1e-5):
+    """Non-affine LayerNorm (``csrc/layer_norm_cuda.cpp:260`` ``forward``)."""
+    axes = _norm_axes(x, normalized_shape)
+    x32 = x.astype(jnp.float32)
+    mean, var = _stats(x32, axes)
+    return ((x32 - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+
+
+def _ln_fwd(x, normalized_shape, eps):
+    axes = _norm_axes(x, normalized_shape)
+    x32 = x.astype(jnp.float32)
+    mean, var = _stats(x32, axes)
+    invvar = jax.lax.rsqrt(var + eps)
+    y = (x32 - mean) * invvar
+    return y.astype(x.dtype), (x, mean, invvar)
+
+
+def _ln_bwd(normalized_shape, eps, res, dy):
+    x, mean, invvar = res
+    axes = _norm_axes(x, normalized_shape)
+    x32 = x.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    xhat = (x32 - mean) * invvar
+    n = np.prod([x.shape[a] for a in axes])
+    s1 = jnp.sum(dy32, axis=axes, keepdims=True)
+    s2 = jnp.sum(dy32 * xhat, axis=axes, keepdims=True)
+    dx = (invvar / n) * (n * dy32 - s1 - xhat * s2)
+    return (dx.astype(x.dtype),)
+
+
+fused_layer_norm.defvjp(_ln_fwd, _ln_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def fused_rms_norm_affine(x, weight, normalized_shape, eps=1e-5):
+    """RMSNorm with affine weight (newer apex ``fused_rms_norm_affine``,
+    ``apex/normalization/fused_layer_norm.py`` upstream API parity)."""
+    y, _ = _rms_fwd_core(x, weight, normalized_shape, eps)
+    return y
+
+
+def _rms_fwd_core(x, weight, normalized_shape, eps):
+    axes = _norm_axes(x, normalized_shape)
+    x32 = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x32), axis=axes, keepdims=True)
+    invrms = jax.lax.rsqrt(ms + eps)
+    y = x32 * invrms * weight.astype(jnp.float32)
+    return y.astype(weight.dtype), invrms
+
+
+def _rms_fwd_vjp(x, weight, normalized_shape, eps):
+    y, invrms = _rms_fwd_core(x, weight, normalized_shape, eps)
+    return y, (x, weight, invrms)
+
+
+def _rms_bwd(normalized_shape, eps, res, dy):
+    x, weight, invrms = res
+    axes = _norm_axes(x, normalized_shape)
+    x32 = x.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    w32 = weight.astype(jnp.float32)
+    xhat = x32 * invrms
+    dxhat = dy32 * w32
+    n = np.prod([x.shape[a] for a in axes])
+    dx = invrms * (dxhat - xhat * (jnp.sum(dxhat * xhat, axis=axes, keepdims=True) / n))
+    red_axes = tuple(range(x.ndim - len(axes)))
+    dw = jnp.sum(dy32 * xhat, axis=red_axes)
+    return dx.astype(x.dtype), dw.astype(weight.dtype)
+
+
+fused_rms_norm_affine.defvjp(_rms_fwd_vjp, _rms_bwd)
+
+
+def fused_rms_norm(x, normalized_shape, eps=1e-5):
+    axes = _norm_axes(x, normalized_shape)
+    x32 = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x32), axis=axes, keepdims=True)
+    return (x32 * jax.lax.rsqrt(ms + eps)).astype(x.dtype)

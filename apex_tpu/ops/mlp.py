@@ -1,0 +1,43 @@
+"""Fused N-layer MLP.
+
+Reference: ``csrc/mlp_cuda.cu`` (cublasGemmEx chains + fused bias/act
+kernels :58-150) exposed through ``apex/mlp/mlp.py:8-79`` — the whole MLP
+(every layer's GEMM+bias+activation) runs as one autograd Function.
+
+TPU: one jitted composition; XLA fuses each bias+activation into its MXU
+matmul, which is the entire benefit the CUDA version buys. Weights use the
+torch ``[out, in]`` layout for parity with the apex module.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.policy import half_function
+
+
+def _activation(name):
+    if name == "none":
+        return lambda x: x
+    if name == "relu":
+        return jax.nn.relu
+    if name == "sigmoid":
+        return jax.nn.sigmoid
+    raise ValueError(f"activation must be none/relu/sigmoid, got {name}")
+
+
+@half_function
+def mlp_forward(x, weights, biases, activation: str = "relu"):
+    """Run the full MLP: ``x -> [dense+bias+act]*N`` (act skipped on last
+    layer is NOT apex behavior — apex applies the activation to every layer
+    including the last, ``csrc/mlp.cpp`` forward loop)."""
+    act = _activation(activation)
+    h = x
+    for w, b in zip(weights, biases):
+        h = jax.lax.dot_general(
+            h, w, dimension_numbers=(((h.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        h = (h + b.astype(jnp.float32))
+        h = act(h).astype(x.dtype)
+    return h

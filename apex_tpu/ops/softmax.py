@@ -1,0 +1,87 @@
+"""Fused scaled-masked softmax (causal and arbitrary-mask variants).
+
+Reference: ``csrc/megatron/scaled_upper_triang_masked_softmax.h`` and
+``scaled_masked_softmax.h:98-149`` — warp-level fused scale+mask+softmax
+for attention scores, seqlen ≤ 2048, with explicit backward kernels.
+
+TPU: fp32-stable fused softmax in one jit region; no seqlen cap. Backward
+uses the standard softmax VJP expressed through ``jax.custom_vjp`` to
+guarantee the fused recompute-free form (y, dy -> y*(dy - sum(dy*y)))
+matching the reference backward kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _softmax_fwd_math(scores32):
+    m = jnp.max(scores32, axis=-1, keepdims=True)
+    e = jnp.exp(scores32 - jax.lax.stop_gradient(m))
+    return e / jnp.sum(e, axis=-1, keepdims=True)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def scaled_masked_softmax(x, mask, scale):
+    """softmax(x*scale masked by additive -inf where ``mask`` is True).
+
+    ``mask``: boolean (True = masked out), broadcastable to ``x``
+    (reference passes a 0/1 uint8 pad mask,
+    ``csrc/megatron/scaled_masked_softmax_cuda.cu``). ``mask=None`` gives
+    plain scaled softmax.
+    """
+    y, _ = _sms_fwd(x, mask, scale)
+    return y
+
+
+def _sms_fwd(x, mask, scale):
+    x32 = x.astype(jnp.float32) * scale
+    if mask is not None:
+        x32 = jnp.where(mask, -10000.0, x32)
+    y = _softmax_fwd_math(x32).astype(x.dtype)
+    return y, (y,)
+
+
+def _sms_bwd(scale, res, dy):
+    (y,) = res
+    y32 = y.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    dx = y32 * (dy32 - jnp.sum(dy32 * y32, axis=-1, keepdims=True))
+    return ((dx * scale).astype(y.dtype), None)
+
+
+scaled_masked_softmax.defvjp(_sms_fwd, _sms_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scaled_upper_triang_masked_softmax(x, scale):
+    """Causal (upper-triangular masked) scaled softmax for [..., sq, sk]
+    (``csrc/megatron/scaled_upper_triang_masked_softmax.h``)."""
+    y, _ = _sutms_fwd(x, scale)
+    return y
+
+
+def _causal_mask(sq, sk):
+    return jnp.arange(sk)[None, :] > jnp.arange(sq)[:, None] + (sk - sq)
+
+
+def _sutms_fwd(x, scale):
+    sq, sk = x.shape[-2], x.shape[-1]
+    x32 = x.astype(jnp.float32) * scale
+    x32 = jnp.where(_causal_mask(sq, sk), -10000.0, x32)
+    y = _softmax_fwd_math(x32).astype(x.dtype)
+    return y, (y,)
+
+
+def _sutms_bwd(scale, res, dy):
+    (y,) = res
+    y32 = y.astype(jnp.float32)
+    dy32 = dy.astype(jnp.float32)
+    dx = y32 * (dy32 - jnp.sum(dy32 * y32, axis=-1, keepdims=True))
+    return ((dx * scale).astype(y.dtype),)
+
+
+scaled_upper_triang_masked_softmax.defvjp(_sutms_fwd, _sutms_bwd)

@@ -1,0 +1,15 @@
+"""apex_tpu.optimizers — fused optimizers.
+
+Reference: ``apex/optimizers/__init__.py`` (FusedSGD, FusedAdam, FusedLAMB,
+FusedNovoGrad, FusedAdagrad, plus FusedMixedPrecisionLamb in newer trees).
+LARC lives in ``apex.parallel`` in the reference but is re-exported here
+too for convenience.
+"""
+
+from apex_tpu.optimizers.base import FusedOptimizerBase, OptimizerState, GroupState  # noqa: F401
+from apex_tpu.optimizers.fused_sgd import FusedSGD  # noqa: F401
+from apex_tpu.optimizers.fused_adam import FusedAdam, FusedAdamW  # noqa: F401
+from apex_tpu.optimizers.fused_lamb import FusedLAMB  # noqa: F401
+from apex_tpu.optimizers.fused_novograd import FusedNovoGrad  # noqa: F401
+from apex_tpu.optimizers.fused_adagrad import FusedAdagrad  # noqa: F401
+from apex_tpu.optimizers.larc import LARC, larc_transform  # noqa: F401

@@ -1,0 +1,245 @@
+"""Fused optimizer base: flat-buffer fused updates with amp semantics.
+
+Reference pattern: every apex fused optimizer groups params by dtype and
+makes 1–2 ``multi_tensor_applier`` kernel launches per group per step
+(e.g. ``apex/optimizers/fused_adam.py:90-173``). The TPU equivalent packs
+each param group into one fp32 flat buffer so the whole update is a single
+fused elementwise XLA loop over contiguous memory (MXU-free, HBM-bandwidth
+bound — exactly what the multi-tensor kernels optimize for), then unpacks
+back to the model pytree/dtypes.
+
+Design:
+- functional core: ``opt.init(params) -> state``; ``opt.apply(state,
+  params, grads, skip=...) -> (new_params, new_state)`` — pure, jit-safe,
+  ``skip`` is a traced bool implementing amp's skip-on-overflow (apex
+  patches ``optimizer.step`` to a no-op for one call,
+  ``apex/amp/handle.py:128-154``; here it is a ``lax.cond``).
+- master weights: with ``master_weights=True`` (amp O2) the state carries
+  a persistent fp32 flat master copy; model params are produced by
+  casting master down each step — the functional analog of
+  ``_master_params_to_model_params`` (``apex/amp/_process_optimizer.py:14-25``).
+- stateful shell: ``opt.initialize_state(params)`` + ``opt.step(grads)``
+  gives the imperative apex call shape for user loops; it also honors an
+  armed amp scaler (unscale + overflow detect + scale update inside one
+  jitted call).
+- param groups: a list of ``{"params": pytree, "lr": ..., ...}`` dicts
+  mirroring torch/apex param_groups; per-group hyperparams override the
+  defaults.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.flat import FlatBuffer
+from apex_tpu.utils.tree import tree_all_finite
+
+
+class GroupState(NamedTuple):
+    """Per-param-group slice of optimizer state."""
+
+    step: jax.Array           # i32 scalar — increments only on applied steps
+    master: jax.Array | None  # fp32 flat master params (O2) or None
+    slots: Any                # optimizer-specific moment buffers (flat or tree)
+
+
+class OptimizerState(NamedTuple):
+    groups: tuple
+
+
+class FusedOptimizerBase:
+    """Shared machinery for all fused optimizers."""
+
+    def __init__(self, params=None, defaults: dict | None = None,
+                 master_weights: bool = False, master_dtype=jnp.float32):
+        self.defaults = dict(defaults or {})
+        self.master_weights = master_weights
+        self.master_dtype = master_dtype
+        self.param_groups: list[dict] = []
+        self._specs: list[FlatBuffer] = []
+        # stateful-API fields
+        self.state: OptimizerState | None = None
+        self.params = None
+        self._scaler = None
+        self._delay_unscale = False
+        self._jit_step = None
+        if params is not None:
+            is_group = isinstance(params, dict) and "params" in params
+            self.add_param_group(params if is_group else {"params": params})
+
+    # -- group management (torch-style, apex/amp/_process_optimizer.py:440-487
+    #    patches add_param_group to keep amp consistent; here it is natively
+    #    consistent because state is rebuilt functionally) ------------------
+    def add_param_group(self, group: dict):
+        group = dict(group)
+        for k, v in self.defaults.items():
+            group.setdefault(k, v)
+        self.param_groups.append(group)
+        self._specs.append(FlatBuffer.from_tree(group["params"]))
+        if self.params is not None:
+            # re-init stateful params/state to include the new group
+            self.initialize_state(self._all_params())
+        self._jit_step = None
+
+    def _all_params(self):
+        return [g["params"] for g in self.param_groups]
+
+    # -- to be provided by subclasses --------------------------------------
+    def _init_slots(self, flat_p32: jax.Array, spec: FlatBuffer, group: dict) -> Any:
+        raise NotImplementedError
+
+    def _update(self, flat_p32, flat_g32, slots, step, group, spec):
+        """Return (new_flat_p32, new_slots). Pure fp32 math on flat buffers."""
+        raise NotImplementedError
+
+    # -- functional API ----------------------------------------------------
+    def init(self, params=None) -> OptimizerState:
+        if params is not None and not self.param_groups:
+            self.add_param_group({"params": params})
+        elif params is not None:
+            self.param_groups[0]["params"] = params
+            self._specs[0] = FlatBuffer.from_tree(params)
+        gs = []
+        for group, spec in zip(self.param_groups, self._specs):
+            flat = spec.pack(group["params"], dtype=self.master_dtype)
+            master = flat if self.master_weights else None
+            gs.append(GroupState(
+                step=jnp.asarray(0, jnp.int32),
+                master=master,
+                slots=self._init_slots(flat, spec, group),
+            ))
+        return OptimizerState(groups=tuple(gs))
+
+    def apply(self, state: OptimizerState, params, grads, skip=None, **overrides):
+        """One optimizer step over all groups.
+
+        ``params``/``grads``: pytree (single group) or list of pytrees
+        (one per group). ``skip``: traced bool; True leaves params and
+        state untouched (amp overflow skip).
+        """
+        # Single group: params is the group's pytree (even if it is a list).
+        # Multiple groups: params must be a list of per-group pytrees.
+        single = len(self.param_groups) == 1
+        plist = [params] if single else list(params)
+        glist = [grads] if single else list(grads)
+        if skip is None:
+            skip = jnp.asarray(False)
+
+        new_params, new_groups = [], []
+        for group, spec, gstate, p, g in zip(self.param_groups, self._specs, state.groups, plist, glist):
+            group = {**group, **{k: v for k, v in overrides.items() if v is not None}}
+            flat_g = spec.pack(g, dtype=jnp.float32)
+            flat_p = gstate.master if gstate.master is not None else spec.pack(p, dtype=jnp.float32)
+            step = gstate.step + 1
+
+            def _do(flat_p=flat_p, flat_g=flat_g, slots=gstate.slots, step=step,
+                    group=group, spec=spec):
+                return self._update(flat_p, flat_g, slots, step, group, spec)
+
+            def _skip(flat_p=flat_p, slots=gstate.slots):
+                return flat_p, slots
+
+            new_flat_p, new_slots = jax.lax.cond(skip, _skip, _do)
+            new_step = jnp.where(skip, gstate.step, step)
+            master = new_flat_p if gstate.master is not None else None
+            new_groups.append(GroupState(new_step.astype(jnp.int32), master, new_slots))
+
+            # model params take each leaf's own dtype (fp32->half downcast in
+            # O2 master mode — _process_optimizer.py:353-364)
+            new_params.append(spec.unpack(new_flat_p))
+
+        out_params = new_params[0] if single else new_params
+        return out_params, OptimizerState(groups=tuple(new_groups))
+
+    # -- amp hooks ---------------------------------------------------------
+    def configure_amp(self, properties, scaler):
+        """Called by ``amp.initialize`` (frontend.py): adopt master-weight
+        mode and attach the scaler."""
+        if properties.master_weights:
+            self.master_weights = True
+        self._scaler = scaler
+
+    def arm_scaler(self, scaler, delay_unscale: bool = False):
+        self._scaler = scaler
+        self._delay_unscale = delay_unscale
+
+    # -- stateful API ------------------------------------------------------
+    def initialize_state(self, params=None):
+        if params is not None:
+            if isinstance(params, (list, tuple)) and len(self.param_groups) == len(params):
+                for g, p, i in zip(self.param_groups, params, range(len(params))):
+                    g["params"] = p
+                    self._specs[i] = FlatBuffer.from_tree(p)
+            else:
+                if not self.param_groups:
+                    self.add_param_group({"params": params})
+                else:
+                    self.param_groups[0]["params"] = params
+                    self._specs[0] = FlatBuffer.from_tree(params)
+        self.params = self._all_params()
+        if len(self.params) == 1:
+            self.params = self.params[0]
+        self.state = self.init()
+        return self.state
+
+    def step(self, grads=None, closure=None):
+        """Imperative step for apex-style loops.
+
+        If an amp scaler is armed, performs unscale + overflow-skip + scale
+        update (the ``_post_amp_backward`` + wrapped-step sequence,
+        ``apex/amp/_process_optimizer.py:161-202,353-364``) in one jitted
+        call. Returns the new params (also stored on ``self.params``).
+        """
+        if closure is not None:
+            raise NotImplementedError("closure is not supported by fused optimizers")
+        if self.state is None:
+            self.initialize_state()
+        if grads is None:
+            raise ValueError("step() requires grads (JAX has no .grad attributes)")
+
+        if self._scaler is not None and self._delay_unscale:
+            raise RuntimeError(
+                "optimizer.step() called while delay_unscale=True is armed: "
+                "gradients are still scaled. Accumulate grads and call step() "
+                "from a scale_loss context without delay_unscale "
+                "(cf. apex/amp/handle.py:67-79).")
+        if self._scaler is not None:
+            from apex_tpu.amp import scaler as scaler_mod
+
+            def _full(params, state, sstate, grads):
+                g, found_inf = scaler_mod.unscale(grads, sstate)
+                p, st = self.apply(state, params, g, skip=found_inf)
+                ss = self._scaler.update_state(sstate, found_inf)
+                return p, st, ss
+
+            if self._jit_step is None:
+                self._jit_step = jax.jit(_full)
+            self.params, self.state, self._scaler.state = self._jit_step(
+                self.params, self.state, self._scaler.state, grads)
+        else:
+            # no scaler: raw optimizer semantics, no overflow guard
+            # (matches torch/apex where the bare optimizer never checks)
+            self.params, self.state = self.apply(self.state, self.params, grads)
+        return self.params
+
+    def zero_grad(self, set_to_none: bool = True):
+        """No-op: JAX grads are values, not accumulated attributes. Kept for
+        API parity (apex patches this for master-weight elision,
+        ``apex/amp/_process_optimizer.py:104-123``)."""
+
+    # -- checkpointing -----------------------------------------------------
+    def state_dict(self) -> dict:
+        return {
+            "state": jax.tree.map(lambda x: x, self.state),
+            "param_group_hparams": [
+                {k: v for k, v in g.items() if k != "params"} for g in self.param_groups
+            ],
+        }
+
+    def load_state_dict(self, sd: dict):
+        self.state = sd["state"]
+        for g, h in zip(self.param_groups, sd.get("param_group_hparams", [])):
+            g.update(h)

@@ -1,0 +1,37 @@
+"""FusedAdagrad.
+
+Reference: ``apex/optimizers/fused_adagrad.py:43-114`` + kernel
+``csrc/multi_tensor_adagrad.cu`` (MODE_0 L2 regularization folded into the
+gradient, ``adagrad_w_mode`` decoupled decay).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import FusedOptimizerBase
+
+
+class FusedAdagrad(FusedOptimizerBase):
+    def __init__(self, params=None, lr=1e-2, eps=1e-10, weight_decay=0.0,
+                 set_grad_none=False, adagrad_w_mode=False,
+                 *, master_weights=False):
+        defaults = dict(lr=lr, eps=eps, weight_decay=weight_decay)
+        self.adagrad_w_mode = adagrad_w_mode
+        super().__init__(params, defaults, master_weights=master_weights)
+
+    def _init_slots(self, flat_p32, spec, group):
+        return {"sum": jnp.zeros_like(flat_p32)}
+
+    def _update(self, p, g, slots, step, group, spec):
+        lr = jnp.asarray(group["lr"], jnp.float32)
+        eps = group["eps"]
+        wd = group.get("weight_decay", 0.0)
+        s = slots["sum"]
+        if wd != 0.0 and not self.adagrad_w_mode:
+            g = g + wd * p
+        s = s + g * g
+        update = g / (jnp.sqrt(s) + eps)
+        if wd != 0.0 and self.adagrad_w_mode:
+            update = update + wd * p
+        return p - lr * update, {"sum": s}

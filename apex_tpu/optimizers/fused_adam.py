@@ -1,0 +1,69 @@
+"""FusedAdam — fused Adam/AdamW.
+
+Reference: ``apex/optimizers/fused_adam.py:4-173`` + kernel
+``csrc/multi_tensor_adam.cu:23-60`` (AdamFunctor, fp32 math regardless of
+storage dtype, ``adam_w_mode`` selecting decoupled weight decay vs L2,
+``bias_correction`` flag, step-skip via the overflow noop flag).
+
+TPU: the whole update (two moment EMAs + bias correction + decay + write)
+is one fused elementwise pass over the fp32 flat buffer.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import FusedOptimizerBase
+
+
+class FusedAdam(FusedOptimizerBase):
+    def __init__(self, params=None, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, adam_w_mode=True,
+                 weight_decay=0.0, amsgrad=False, *, master_weights=False,
+                 set_grad_none=False, capturable=False):
+        if amsgrad:
+            # parity with apex/optimizers/fused_adam.py:77-78
+            raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
+        defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
+                        eps=eps, weight_decay=weight_decay)
+        self.adam_w_mode = adam_w_mode
+        super().__init__(params, defaults, master_weights=master_weights)
+
+    def _init_slots(self, flat_p32, spec, group):
+        return {"exp_avg": jnp.zeros_like(flat_p32), "exp_avg_sq": jnp.zeros_like(flat_p32)}
+
+    def _update(self, p, g, slots, step, group, spec):
+        lr = jnp.asarray(group["lr"], jnp.float32)
+        beta1, beta2 = group["betas"]
+        eps = group["eps"]
+        wd = group.get("weight_decay", 0.0)
+        m, v = slots["exp_avg"], slots["exp_avg_sq"]
+
+        if not self.adam_w_mode and wd != 0.0:
+            # ADAM_MODE_0 (L2): decay folded into the gradient
+            # (csrc/multi_tensor_adam.cu AdamFunctor L2 branch).
+            g = g + wd * p
+
+        m = beta1 * m + (1.0 - beta1) * g
+        v = beta2 * v + (1.0 - beta2) * g * g
+
+        if group.get("bias_correction", True):
+            stepf = step.astype(jnp.float32)
+            bc1 = 1.0 - jnp.power(beta1, stepf)
+            bc2 = 1.0 - jnp.power(beta2, stepf)
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+
+        update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+        if self.adam_w_mode and wd != 0.0:
+            update = update + wd * p
+        return p - lr * update, {"exp_avg": m, "exp_avg_sq": v}
+
+
+class FusedAdamW(FusedAdam):
+    """Convenience alias with decoupled weight decay always on."""
+
+    def __init__(self, params=None, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=1e-2, **kw):
+        super().__init__(params, lr=lr, betas=betas, eps=eps,
+                         weight_decay=weight_decay, adam_w_mode=True, **kw)

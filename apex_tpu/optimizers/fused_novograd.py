@@ -1,0 +1,86 @@
+"""FusedNovoGrad — fused NovoGrad (per-tensor second moments).
+
+Reference: ``apex/optimizers/fused_novograd.py:67-198`` + kernel
+``csrc/multi_tensor_novograd.cu``: the second moment is a *scalar per
+tensor* (EMA of the squared grad norm), the first moment is
+``m = β1·m + g/√(v)+ε (+ wd·p)``, with options ``reg_inside_moment``,
+``grad_averaging``, ``norm_type`` (0=inf, 2=L2) and ``init_zero``.
+
+TPU: per-tensor norms via ``segment_sum`` over the flat buffer; moments
+stay flat; the per-tensor scalar v is a small vector indexed back through
+the static segment map.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import FusedOptimizerBase
+from apex_tpu.optimizers.fused_lamb import segment_ids_for
+
+
+class FusedNovoGrad(FusedOptimizerBase):
+    def __init__(self, params=None, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                 amsgrad=False, reg_inside_moment=False, grad_averaging=True,
+                 norm_type=2, init_zero=False, set_grad_none=False,
+                 *, master_weights=False):
+        if amsgrad:
+            raise RuntimeError("FusedNovoGrad does not support the AMSGrad variant.")
+        if norm_type not in (0, 2):
+            raise RuntimeError(f"FusedNovoGrad only supports l2/inf norm now, got {norm_type}")
+        defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
+                        eps=eps, weight_decay=weight_decay,
+                        grad_averaging=grad_averaging)
+        self.moment_mode = 0 if reg_inside_moment else 1
+        self.norm_type = norm_type
+        self.init_zero = init_zero
+        super().__init__(params, defaults, master_weights=master_weights)
+
+    def _init_slots(self, flat_p32, spec, group):
+        n = len(spec.sizes)
+        return {
+            "exp_avg": jnp.zeros_like(flat_p32),
+            # per-tensor scalar second moment (fused_novograd.py:148-160)
+            "exp_avg_sq": jnp.zeros((n,), jnp.float32),
+            "initialized": jnp.asarray(False),
+        }
+
+    def _tensor_norms(self, g, spec):
+        seg = segment_ids_for(spec)
+        n = len(spec.sizes)
+        if self.norm_type == 2:
+            return jnp.sqrt(jax.ops.segment_sum(g * g, seg, num_segments=n))
+        return jax.ops.segment_max(jnp.abs(g), seg, num_segments=n)
+
+    def _update(self, p, g, slots, step, group, spec):
+        lr = jnp.asarray(group["lr"], jnp.float32)
+        beta1, beta2 = group["betas"]
+        eps = group["eps"]
+        wd = group.get("weight_decay", 0.0)
+        grad_averaging = group.get("grad_averaging", True)
+        seg = segment_ids_for(spec)
+        m, v, inited = slots["exp_avg"], slots["exp_avg_sq"], slots["initialized"]
+
+        g_norm = self._tensor_norms(g, spec)
+        # init_zero=False: first step seeds v with ||g||² (fused_novograd.py:151-158)
+        v_seed = jnp.zeros_like(g_norm) if self.init_zero else g_norm * g_norm if self.norm_type == 2 else g_norm
+        v_next = jnp.where(inited, beta2 * v + (1.0 - beta2) * (g_norm * g_norm if self.norm_type == 2 else g_norm), v_seed)
+        denom_t = jnp.sqrt(v_next) if self.norm_type == 2 else v_next
+        denom = denom_t[seg] + eps
+
+        g_scaled = g / denom
+        if wd != 0.0 and self.moment_mode == 0:
+            g_scaled = g_scaled + wd * p  # reg inside moment
+        beta1_eff = (1.0 - beta1) if grad_averaging else 1.0
+        m = beta1 * m + beta1_eff * g_scaled
+
+        update = m
+        if wd != 0.0 and self.moment_mode == 1:
+            update = update + wd * p
+        if group.get("bias_correction", True):
+            stepf = step.astype(jnp.float32)
+            bc1 = 1.0 - jnp.power(beta1, stepf)
+            update = update / bc1
+        return p - lr * update, {"exp_avg": m, "exp_avg_sq": v_next, "initialized": jnp.asarray(True)}

@@ -1,0 +1,60 @@
+"""FusedSGD — fused momentum SGD.
+
+Reference: ``apex/optimizers/fused_sgd.py:7-176`` (kernel
+``csrc/multi_tensor_sgd_kernel.cu``): momentum/dampening/nesterov/weight
+decay semantics identical to ``torch.optim.SGD``, applied across the whole
+param list in one launch, with ``materialize_master_grads`` and fp16-out
+support for the amp O2 path (``fused_sgd.py:79-104``).
+
+TPU: one fused elementwise update over a single fp32 flat buffer per param
+group; master-weight/half-out handling comes from the base class.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.optimizers.base import FusedOptimizerBase
+
+
+class FusedSGD(FusedOptimizerBase):
+    def __init__(self, params=None, lr=1e-3, momentum=0.0, dampening=0.0,
+                 weight_decay=0.0, nesterov=False, *,
+                 wd_after_momentum=False, materialize_master_grads=True,
+                 master_weights=False, set_grad_none=False):
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError("Nesterov momentum requires a momentum and zero dampening")
+        defaults = dict(lr=lr, momentum=momentum, dampening=dampening,
+                        weight_decay=weight_decay, nesterov=nesterov)
+        # wd_after_momentum mirrors the kernel's wd_after_momentum flag
+        # (apex/optimizers/fused_sgd.py:71, csrc/multi_tensor_sgd_kernel.cu).
+        self.wd_after_momentum = wd_after_momentum
+        self.materialize_master_grads = materialize_master_grads
+        super().__init__(params, defaults, master_weights=master_weights)
+
+    def _init_slots(self, flat_p32, spec, group):
+        if group.get("momentum", 0.0) != 0.0:
+            return {"momentum_buffer": jnp.zeros_like(flat_p32), "initialized": jnp.asarray(False)}
+        return {}
+
+    def _update(self, p, g, slots, step, group, spec):
+        lr = jnp.asarray(group["lr"], jnp.float32)
+        momentum = group.get("momentum", 0.0)
+        dampening = group.get("dampening", 0.0)
+        wd = group.get("weight_decay", 0.0)
+        nesterov = group.get("nesterov", False)
+
+        if wd != 0.0 and not self.wd_after_momentum:
+            g = g + wd * p
+        if momentum != 0.0:
+            buf = slots["momentum_buffer"]
+            init = slots["initialized"]
+            # torch SGD semantics: first touch sets buf = g (no dampening).
+            new_buf = jnp.where(init, momentum * buf + (1.0 - dampening) * g, g)
+            d = (g + momentum * new_buf) if nesterov else new_buf
+            slots = {"momentum_buffer": new_buf, "initialized": jnp.asarray(True)}
+        else:
+            d = g
+        if wd != 0.0 and self.wd_after_momentum:
+            d = d + wd * p
+        return p - lr * d, slots

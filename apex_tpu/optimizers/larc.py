@@ -1,0 +1,95 @@
+"""LARC — layer-wise adaptive rate control wrapper.
+
+Reference: ``apex/parallel/LARC.py:5-107``: wraps any optimizer; before
+delegating, rescales each parameter's gradient by the adaptive rate
+``trust_coefficient · ||p|| / (||g|| + wd·||p|| + eps)`` (optionally
+clipped so the effective lr never exceeds the base lr) and moves weight
+decay into the gradient so the inner optimizer sees wd=0.
+
+TPU: a pure per-leaf gradient transform composed in front of the inner
+optimizer's ``apply``; also usable standalone via ``larc_transform``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def larc_transform(params: Any, grads: Any, lr, *, trust_coefficient=0.02,
+                   clip=True, eps=1e-8, weight_decay=0.0):
+    """Return LARC-adjusted grads (weight decay folded in)."""
+    lr = jnp.asarray(lr, jnp.float32)
+
+    def _leaf(p, g):
+        if not jnp.issubdtype(g.dtype, jnp.floating):
+            return g
+        p32 = p.astype(jnp.float32)
+        g32 = g.astype(jnp.float32)
+        p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        g_norm = jnp.sqrt(jnp.sum(g32 * g32))
+        adaptive = trust_coefficient * p_norm / (g_norm + p_norm * weight_decay + eps)
+        if clip:
+            adaptive = jnp.minimum(adaptive / lr, 1.0)
+        adaptive = jnp.where((p_norm > 0) & (g_norm > 0), adaptive, 1.0)
+        return ((g32 + weight_decay * p32) * adaptive).astype(g.dtype)
+
+    return jax.tree.map(_leaf, params, grads)
+
+
+class LARC:
+    """Optimizer wrapper matching the apex object API (``LARC.py:5``)."""
+
+    def __init__(self, optimizer, trust_coefficient=0.02, clip=True, eps=1e-8):
+        self.optim = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+
+    def __getattr__(self, name):
+        return getattr(self.optim, name)
+
+    @property
+    def param_groups(self):
+        return self.optim.param_groups
+
+    def _transform(self, params_list, grads_list):
+        out = []
+        for group, p, g in zip(self.optim.param_groups, params_list, grads_list):
+            wd = group.get("weight_decay", 0.0)
+            out.append(larc_transform(
+                p, g, group.get("lr", 1e-3),
+                trust_coefficient=self.trust_coefficient,
+                clip=self.clip, eps=self.eps, weight_decay=wd))
+        return out
+
+    def init(self, params=None):
+        return self.optim.init(params)
+
+    def apply(self, state, params, grads, skip=None, **overrides):
+        single = len(self.optim.param_groups) == 1
+        plist = [params] if single else list(params)
+        glist = [grads] if single else list(grads)
+        glist = self._transform(plist, glist)
+        # inner optimizer must not re-apply weight decay (LARC.py:97-101)
+        saved = [g.get("weight_decay", 0.0) for g in self.optim.param_groups]
+        for g in self.optim.param_groups:
+            g["weight_decay"] = 0.0
+        try:
+            return self.optim.apply(state, params, glist[0] if single else glist,
+                                    skip=skip, **overrides)
+        finally:
+            for g, wd in zip(self.optim.param_groups, saved):
+                g["weight_decay"] = wd
+
+    def step(self, grads=None):
+        if self.optim.state is None:
+            self.optim.initialize_state()
+        params = self.optim.params
+        single = len(self.optim.param_groups) == 1
+        plist = [params] if single else list(params)
+        glist = [grads] if single else list(grads)
+        glist = self._transform(plist, glist)
+        return self.optim.step(glist[0] if single else glist)

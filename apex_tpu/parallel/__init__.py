@@ -1,0 +1,28 @@
+"""apex_tpu.parallel — data parallelism + synchronized BatchNorm on a mesh.
+
+Reference: ``apex/parallel/__init__.py`` (DistributedDataParallel,
+Reducer, SyncBatchNorm, convert_syncbn_model, LARC, ReduceOp re-export).
+"""
+
+from apex_tpu.parallel.distributed import (  # noqa: F401
+    DistributedDataParallel,
+    Reducer,
+    allreduce_gradients,
+    flat_dist_call,
+)
+from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
+    SyncBatchNorm,
+    convert_syncbn_model,
+    create_syncbn_process_group,
+)
+from apex_tpu.optimizers.larc import LARC  # noqa: F401
+
+
+class ReduceOp:
+    """Mesh-collective reduce-op names (parity with the
+    ``torch.distributed.ReduceOp`` re-export, ``apex/parallel/__init__.py:3-8``)."""
+
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PRODUCT = "product"

@@ -1,0 +1,14 @@
+"""apex_tpu.transformer — Megatron-style model parallelism on a TPU mesh.
+
+Reference package: ``apex/transformer`` (``apex/transformer/__init__.py``):
+``parallel_state`` (process-group grid), ``tensor_parallel`` (TP layers +
+collectives + RNG/checkpointing), ``pipeline_parallel`` (groups; schedule
+added here as a first-class feature), ``functional`` (fused softmax).
+"""
+
+from apex_tpu.transformer import parallel_state  # noqa: F401
+from apex_tpu.transformer import tensor_parallel  # noqa: F401
+from apex_tpu.transformer import pipeline_parallel  # noqa: F401
+from apex_tpu.transformer import functional  # noqa: F401
+
+from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType, ModelType  # noqa: F401

@@ -1,0 +1,8 @@
+"""apex_tpu.transformer.functional — fused softmax module layer.
+
+Reference: ``apex/transformer/functional/__init__.py`` (FusedScaleMaskSoftmax).
+"""
+
+from apex_tpu.transformer.functional.fused_softmax import (  # noqa: F401
+    FusedScaleMaskSoftmax,
+)

@@ -1,0 +1,223 @@
+"""Model-parallel state: the DP×PP×TP (×CP×EP) grid as one jax Mesh.
+
+Reference: ``apex/transformer/parallel_state.py:53-322`` —
+``initialize_model_parallel(tp, pp, vpp)`` carves the NCCL world into
+data/tensor/pipeline/embedding process groups and stores them in module
+globals, with rank/world-size getters for each.
+
+TPU-native translation: the grid IS a ``jax.sharding.Mesh`` with named
+axes ``("data", "pipeline", "tensor")`` (+ optional ``context`` for
+sequence/ring parallelism and ``expert`` for MoE). "Groups" are mesh axes;
+"ranks" are ``lax.axis_index`` inside shard_map/jit (traced) and plain
+coordinates outside. The embedding group (first+last PP stage,
+``parallel_state.py:124-133``) becomes an ``axis_index_groups`` helper for
+collectives restricted to those stages.
+
+Axis order note: ("data", "pipeline", "tensor") puts tensor-parallel
+neighbours innermost so TP collectives ride the fastest ICI links and DP
+gradient reduction crosses the slower dimension — same locality policy as
+the reference's "tp ranks contiguous" group construction
+(``parallel_state.py:95-122``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+# Canonical axis names
+DATA_AXIS = "data"
+PIPELINE_AXIS = "pipeline"
+TENSOR_AXIS = "tensor"
+CONTEXT_AXIS = "context"   # sequence/ring-attention parallelism (new, §5 gap)
+EXPERT_AXIS = "expert"     # MoE expert parallelism (new)
+
+_MESH: Optional[Mesh] = None
+_VIRTUAL_PIPELINE_WORLD_SIZE: Optional[int] = None
+_VIRTUAL_PIPELINE_RANK: Optional[int] = None
+_PIPELINE_SPLIT_RANK: Optional[int] = None
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size_: int = 1,
+    pipeline_model_parallel_size_: int = 1,
+    virtual_pipeline_model_parallel_size_: Optional[int] = None,
+    pipeline_model_parallel_split_rank_: Optional[int] = None,
+    context_parallel_size_: int = 1,
+    expert_parallel_size_: int = 1,
+    *,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build and install the global mesh.
+
+    Mirrors ``initialize_model_parallel`` (``parallel_state.py:53-156``):
+    world must factor as dp·pp·tp(·cp·ep); virtual-pipeline state is
+    recorded for the interleaved schedule. Returns the Mesh (also kept as
+    module state for the getters).
+    """
+    global _MESH, _VIRTUAL_PIPELINE_WORLD_SIZE, _VIRTUAL_PIPELINE_RANK, _PIPELINE_SPLIT_RANK
+    devs = list(devices if devices is not None else jax.devices())
+    world = len(devs)
+    tp = tensor_model_parallel_size_
+    pp = pipeline_model_parallel_size_
+    cp = context_parallel_size_
+    ep = expert_parallel_size_
+    denom = tp * pp * cp * ep
+    if world % denom != 0:
+        raise RuntimeError(
+            f"world size ({world}) is not divisible by tp ({tp}) x pp ({pp})"
+            f" x cp ({cp}) x ep ({ep})")
+    dp = world // denom
+
+    if virtual_pipeline_model_parallel_size_ is not None:
+        if pp < 2:
+            # parallel_state.py:84-88: interleaved schedule needs pp >= 2
+            raise RuntimeError(
+                "pipeline-model-parallel size should be greater than 1 with "
+                "interleaved schedule")
+        _VIRTUAL_PIPELINE_WORLD_SIZE = virtual_pipeline_model_parallel_size_
+        _VIRTUAL_PIPELINE_RANK = 0
+    else:
+        _VIRTUAL_PIPELINE_WORLD_SIZE = None
+        _VIRTUAL_PIPELINE_RANK = None
+    _PIPELINE_SPLIT_RANK = pipeline_model_parallel_split_rank_
+
+    shape = [dp, pp, tp]
+    names = [DATA_AXIS, PIPELINE_AXIS, TENSOR_AXIS]
+    if cp > 1:
+        shape.insert(1, cp)
+        names.insert(1, CONTEXT_AXIS)
+    if ep > 1:
+        shape.insert(1, ep)
+        names.insert(1, EXPERT_AXIS)
+    arr = np.array(devs).reshape(shape)
+    _MESH = Mesh(arr, tuple(names))
+    return _MESH
+
+
+def model_parallel_is_initialized() -> bool:
+    """``parallel_state.py:159-166``."""
+    return _MESH is not None
+
+
+def get_mesh() -> Mesh:
+    if _MESH is None:
+        raise RuntimeError("model parallel mesh is not initialized")
+    return _MESH
+
+
+def destroy_model_parallel():
+    """``parallel_state.py:(end) destroy_model_parallel``."""
+    global _MESH, _VIRTUAL_PIPELINE_WORLD_SIZE, _VIRTUAL_PIPELINE_RANK, _PIPELINE_SPLIT_RANK
+    _MESH = None
+    _VIRTUAL_PIPELINE_WORLD_SIZE = None
+    _VIRTUAL_PIPELINE_RANK = None
+    _PIPELINE_SPLIT_RANK = None
+
+
+def _axis_size(name: str) -> int:
+    if _MESH is None or name not in _MESH.axis_names:
+        return 1
+    return _MESH.shape[name]
+
+
+# -- world sizes (host-side, static) ---------------------------------------
+
+def get_tensor_model_parallel_world_size() -> int:
+    """``parallel_state.py:214-219``."""
+    return _axis_size(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return _axis_size(PIPELINE_AXIS)
+
+
+def get_data_parallel_world_size() -> int:
+    return _axis_size(DATA_AXIS)
+
+
+def get_context_parallel_world_size() -> int:
+    return _axis_size(CONTEXT_AXIS)
+
+
+def get_expert_parallel_world_size() -> int:
+    return _axis_size(EXPERT_AXIS)
+
+
+# -- ranks: traced inside shard_map, 0 outside ------------------------------
+
+def _axis_rank(name: str):
+    try:
+        return jax.lax.axis_index(name)
+    except NameError:
+        return 0
+
+
+def get_tensor_model_parallel_rank():
+    """Inside shard_map: traced index on the tensor axis
+    (``parallel_state.py:252-258`` analog). Outside: 0."""
+    return _axis_rank(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return _axis_rank(PIPELINE_AXIS)
+
+
+def get_data_parallel_rank():
+    return _axis_rank(DATA_AXIS)
+
+
+def get_context_parallel_rank():
+    return _axis_rank(CONTEXT_AXIS)
+
+
+# -- pipeline stage predicates (static, per-stage — used when building the
+#    per-stage module list; parallel_state.py:260-322) ----------------------
+
+def is_pipeline_first_stage(stage: int = 0, ignore_virtual: bool = False) -> bool:
+    if not ignore_virtual and _VIRTUAL_PIPELINE_WORLD_SIZE is not None:
+        if _VIRTUAL_PIPELINE_RANK != 0:
+            return False
+    return stage == 0
+
+
+def is_pipeline_last_stage(stage: int, ignore_virtual: bool = False) -> bool:
+    if not ignore_virtual and _VIRTUAL_PIPELINE_WORLD_SIZE is not None:
+        if _VIRTUAL_PIPELINE_RANK != _VIRTUAL_PIPELINE_WORLD_SIZE - 1:
+            return False
+    return stage == get_pipeline_model_parallel_world_size() - 1
+
+
+def get_virtual_pipeline_model_parallel_world_size():
+    return _VIRTUAL_PIPELINE_WORLD_SIZE
+
+
+def get_virtual_pipeline_model_parallel_rank():
+    return _VIRTUAL_PIPELINE_RANK
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: int):
+    global _VIRTUAL_PIPELINE_RANK
+    _VIRTUAL_PIPELINE_RANK = rank
+
+
+def get_pipeline_model_parallel_split_rank():
+    return _PIPELINE_SPLIT_RANK
+
+
+def get_embedding_axis_index_groups():
+    """Groups pairing first and last pipeline stage for tied-embedding grad
+    reduction (``parallel_state.py:124-133`` embedding group). Returns
+    ``axis_index_groups`` for a psum over the pipeline axis, or None when
+    pp == 1."""
+    pp = get_pipeline_model_parallel_world_size()
+    if pp == 1:
+        return None
+    if pp == 2:
+        return [[0, 1]]
+    # only first+last participate; middle stages form singleton groups
+    groups = [[0, pp - 1]] + [[i] for i in range(1, pp - 1)]
+    return groups

@@ -1,0 +1,22 @@
+"""apex_tpu.transformer.pipeline_parallel — pipeline schedule over the mesh.
+
+Reference status: ``apex/transformer/parallel_state.py`` creates PP groups
+and virtual-pipeline rank state (:95-156, 252-322) but ships **no schedule
+engine and no p2p layer** (SURVEY §2.3). Here both exist: ``p2p`` maps
+stage-to-stage transfer onto ``ppermute`` over the ``pipeline`` mesh axis,
+and ``schedules`` provides an SPMD GPipe-style fill-drain schedule whose
+backward falls out of ``jax.grad`` through the scanned pipeline —
+the TPU-native replacement for hand-written 1F1B bookkeeping.
+"""
+
+from apex_tpu.transformer.pipeline_parallel.p2p import (  # noqa: F401
+    send_forward_recv_forward,
+    send_backward_recv_backward,
+    ring_shift,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
+    pipeline_apply,
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+)

@@ -1,0 +1,40 @@
+"""Stage-to-stage transfer primitives over the pipeline mesh axis.
+
+The reference snapshot has no p2p layer (SURVEY §2.3); Megatron-style
+``send_forward``/``recv_backward`` pairs translate on TPU to a single
+``ppermute`` ring shift per direction — XLA schedules it asynchronously,
+which is the overlap the CUDA implementations hand-build with streams.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from apex_tpu.transformer import parallel_state as ps
+
+
+def ring_shift(x, axis_name: str = ps.PIPELINE_AXIS, reverse: bool = False,
+               wrap: bool = True):
+    """Shift ``x`` one stage forward (rank i → i+1), or backward with
+    ``reverse``. ``wrap=False`` leaves the edge stage receiving zeros
+    (ppermute semantics for unlisted destinations)."""
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    if reverse:
+        perm = [(i, i - 1) for i in range(1, n)] + ([(0, n - 1)] if wrap else [])
+    else:
+        perm = [(i, i + 1) for i in range(n - 1)] + ([(n - 1, 0)] if wrap else [])
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def send_forward_recv_forward(output, axis_name: str = ps.PIPELINE_AXIS):
+    """Every stage sends its activation to the next and receives the
+    previous stage's (stage 0 receives zeros)."""
+    return ring_shift(output, axis_name, reverse=False, wrap=False)
+
+
+def send_backward_recv_backward(grad, axis_name: str = ps.PIPELINE_AXIS):
+    """Every stage sends its input-grad to the previous stage and receives
+    the next stage's (last stage receives zeros)."""
+    return ring_shift(grad, axis_name, reverse=True, wrap=False)

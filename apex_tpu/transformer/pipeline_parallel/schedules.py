@@ -1,0 +1,118 @@
+"""SPMD pipeline schedules.
+
+The reference only ships the group topology (SURVEY §2.3: "no schedule
+engine"); Megatron's schedules drive per-rank send/recv with 1F1B
+bookkeeping. The TPU-native formulation: every stage runs the SAME scanned
+program (SPMD), activations move with one ``ppermute`` per tick, microbatch
+injection/collection are masked by stage index, and the backward schedule
+falls out of ``jax.grad`` of the scan — XLA reverses the pipeline
+automatically, with ``jax.checkpoint`` on the stage function standing in
+for 1F1B's memory discipline.
+
+``pipeline_apply(stage_fn, stage_params, x, n_microbatches)`` must run
+inside ``shard_map`` over the ``pipeline`` mesh axis, with
+``stage_params`` already per-stage (each rank holds its stage's weights)
+and the stage activation shape uniform across stages (standard for
+transformer blocks).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.pipeline_parallel.p2p import send_forward_recv_forward
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x,
+                   n_microbatches: int,
+                   axis_name: str = ps.PIPELINE_AXIS,
+                   remat: bool = True):
+    """Run microbatched GPipe fill-drain over the pipeline axis.
+
+    ``x``: [n_microbatches, mb, ...] input (consumed by stage 0).
+    ``stage_fn(params, h) -> h`` is one stage; output shape == input shape.
+    Returns [n_microbatches, mb, ...] final-stage outputs (valid on the
+    last stage; replicate/psum externally if every stage needs them).
+    """
+    n_stages = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    total_ticks = n_microbatches + n_stages - 1
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    h_shape = x.shape[1:]
+    init_held = jnp.zeros(h_shape, x.dtype)
+    init_out = jnp.zeros((n_microbatches,) + h_shape, x.dtype)
+
+    def tick(carry, t):
+        held, outputs = carry
+        inject_idx = jnp.clip(t, 0, n_microbatches - 1)
+        inject = jax.lax.dynamic_index_in_dim(x, inject_idx, keepdims=False)
+        use_inject = (rank == 0) & (t < n_microbatches)
+        inp = jnp.where(use_inject, inject, held)
+        out = fn(stage_params, inp)
+        # collect on the last stage: tick t carries microbatch t-(n_stages-1)
+        mb = t - (n_stages - 1)
+        valid = (rank == n_stages - 1) & (mb >= 0)
+        mb_c = jnp.clip(mb, 0, n_microbatches - 1)
+        updated = jax.lax.dynamic_update_index_in_dim(outputs, out, mb_c, 0)
+        outputs = jnp.where(valid, updated, outputs)
+        # move activations one stage forward for the next tick
+        held_next = send_forward_recv_forward(out, axis_name)
+        return (held_next, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(tick, (init_held, init_out),
+                                   jnp.arange(total_ticks))
+    return outputs
+
+
+def forward_backward_no_pipelining(loss_fn: Callable, params, batch,
+                                   n_microbatches: int = 1):
+    """Megatron's no-pipelining path: grad-accumulate over microbatches.
+
+    ``loss_fn(params, microbatch) -> scalar``. Returns (mean loss, grads).
+    """
+    def scan_body(acc, mb):
+        loss, g = jax.value_and_grad(loss_fn)(params, mb)
+        return jax.tree.map(lambda a, b: a + b, acc, (loss, g)), None
+
+    zero = (jnp.zeros(()), jax.tree.map(jnp.zeros_like, params))
+    (loss_sum, grad_sum), _ = jax.lax.scan(scan_body, zero, batch)
+    inv = 1.0 / n_microbatches
+    return loss_sum * inv, jax.tree.map(lambda g: g * inv, grad_sum)
+
+
+def forward_backward_pipelining_without_interleaving(
+        stage_fn: Callable, loss_head: Callable, stage_params, x,
+        n_microbatches: int, axis_name: str = ps.PIPELINE_AXIS):
+    """Fill-drain pipeline + loss, returning (loss, stage-param grads).
+
+    ``loss_head(outputs) -> scalar`` applies on the last stage's collected
+    outputs (masked to zero elsewhere, so a final ``psum`` of the loss and
+    grads is exact). Runs inside shard_map over the pipeline axis.
+    """
+    n_stages = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+
+    def full(params):
+        outs = pipeline_apply(stage_fn, params, x, n_microbatches, axis_name)
+        loss = loss_head(outs)
+        return jnp.where(rank == n_stages - 1, loss, 0.0)
+
+    loss, grads = jax.value_and_grad(full)(stage_params)
+    return loss, grads
+
+
+def get_forward_backward_func(virtual_pipeline_model_parallel_size=None,
+                              pipeline_model_parallel_size: int = 1):
+    """Dispatch mirroring Megatron's ``get_forward_backward_func``."""
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            raise NotImplementedError(
+                "interleaved (virtual pipeline) schedule is not implemented "
+                "yet; use the non-interleaved schedule")
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining

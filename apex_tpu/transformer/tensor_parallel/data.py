@@ -1,0 +1,46 @@
+"""TP data broadcast utilities.
+
+Reference: ``apex/transformer/tensor_parallel/data.py`` —
+``broadcast_data(keys, data, datatype)`` sends rank-0's batch to the rest
+of the TP group (with a size handshake, :30-77) so only one rank reads the
+dataloader.
+
+TPU/SPMD: a single controller feeds all devices, so the usual path needs
+no broadcast at all. For shard_map code that materializes per-rank data,
+``broadcast_data`` selects tensor-parallel rank 0's copy via a masked
+psum — semantically identical to the NCCL broadcast.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state as ps
+
+
+def _bcast_from_rank0(x, axis_name):
+    rank = jax.lax.axis_index(axis_name)
+    masked = jnp.where(rank == 0, x, jnp.zeros_like(x))
+    return jax.lax.psum(masked, axis_name)
+
+
+def broadcast_data(keys, data: Mapping, datatype=None,
+                   axis_name: str = ps.TENSOR_AXIS):
+    """Return ``{k: tp-rank-0's data[k]}`` for ``k in keys``.
+
+    Works on any pytree-of-arrays values; ints are round-tripped through
+    the reduction like the reference packs them into a flat tensor.
+    """
+    if ps._axis_size(axis_name) == 1:
+        return {k: data[k] for k in keys}
+    out = {}
+    for k in keys:
+        v = jnp.asarray(data[k])
+        if datatype is not None:
+            v = v.astype(datatype)
+        res = _bcast_from_rank0(v.astype(jnp.float32) if jnp.issubdtype(v.dtype, jnp.integer) else v, axis_name)
+        out[k] = res.astype(v.dtype)
+    return out

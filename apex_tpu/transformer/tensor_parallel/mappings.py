@@ -1,0 +1,134 @@
+"""TP collective mappings: the f/g conjugate autograd pairs.
+
+Reference: ``apex/transformer/tensor_parallel/mappings.py:31-138`` — four
+``torch.autograd.Function`` pairs:
+
+- ``copy_to``:    fwd identity,   bwd all-reduce      (:77-89, "f")
+- ``reduce_from``: fwd all-reduce, bwd identity       (:92-103, "g")
+- ``scatter_to``:  fwd split last dim, bwd all-gather (:106-118)
+- ``gather_from``: fwd all-gather last dim, bwd split (:121-133)
+
+plus the sequence-parallel variants (scatter/gather/reduce-scatter along
+the *sequence* dim) from upstream Megatron.
+
+TPU: each pair is a ``jax.custom_vjp`` over ``lax`` collectives, usable
+inside ``shard_map`` over the ``tensor`` mesh axis. Under pure GSPMD
+(sharding constraints) these are implicit; this explicit layer exists for
+Megatron API parity and for kernels that need manual collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state as ps
+
+
+# -- copy_to: identity / psum ------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_model_parallel_region(x, axis_name: str = ps.TENSOR_AXIS):
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, dy):
+    return (jax.lax.psum(dy, axis_name),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+# -- reduce_from: psum / identity -------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_model_parallel_region(x, axis_name: str = ps.TENSOR_AXIS):
+    return jax.lax.psum(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, dy):
+    return (dy,)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+# -- scatter_to: local split / all-gather -----------------------------------
+
+def _local_chunk(x, axis_name, dim=-1):
+    world = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    size = x.shape[dim] // world
+    return jax.lax.dynamic_slice_in_dim(x, rank * size, size, axis=dim)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def scatter_to_tensor_model_parallel_region(x, axis_name: str = ps.TENSOR_AXIS, dim: int = -1):
+    return _local_chunk(x, axis_name, dim)
+
+
+def _scatter_fwd(x, axis_name, dim):
+    return _local_chunk(x, axis_name, dim), None
+
+
+def _scatter_bwd(axis_name, dim, _, dy):
+    return (jax.lax.all_gather(dy, axis_name, axis=dim if dim >= 0 else dy.ndim + dim, tiled=True),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+# -- gather_from: all-gather / local split ----------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_tensor_model_parallel_region(x, axis_name: str = ps.TENSOR_AXIS, dim: int = -1):
+    return jax.lax.all_gather(x, axis_name, axis=dim if dim >= 0 else x.ndim + dim, tiled=True)
+
+
+def _gather_fwd(x, axis_name, dim):
+    return gather_from_tensor_model_parallel_region(x, axis_name, dim), None
+
+
+def _gather_bwd(axis_name, dim, _, dy):
+    return (_local_chunk(dy, axis_name, dim),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+# -- sequence-parallel variants (dim 0 = sequence, Megatron-SP convention) --
+
+def scatter_to_sequence_parallel_region(x, axis_name: str = ps.TENSOR_AXIS):
+    return scatter_to_tensor_model_parallel_region(x, axis_name, 0)
+
+
+def gather_from_sequence_parallel_region(x, axis_name: str = ps.TENSOR_AXIS):
+    return gather_from_tensor_model_parallel_region(x, axis_name, 0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_scatter_to_sequence_parallel_region(x, axis_name: str = ps.TENSOR_AXIS):
+    """fwd reduce-scatter along dim 0, bwd all-gather — the Megatron-SP
+    "g" in the sequence-parallel MLP/attention sandwich."""
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+
+
+def _rs_fwd(x, axis_name):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True), None
+
+
+def _rs_bwd(axis_name, _, dy):
+    return (jax.lax.all_gather(dy, axis_name, axis=0, tiled=True),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_rs_fwd, _rs_bwd)

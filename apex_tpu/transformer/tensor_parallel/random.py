@@ -1,0 +1,106 @@
+"""Model-parallel RNG management + activation checkpointing.
+
+Reference: ``apex/transformer/tensor_parallel/random.py`` —
+``CudaRNGStatesTracker`` (:131-206) maintains named CUDA RNG states so
+dropout inside TP regions is *different* per tp rank while everything
+outside is identical; ``CheckpointFunction``/``checkpoint`` (:241-311)
+recompute forward in backward with the RNG states forked identically, and
+``memory.py:34-136`` pre-allocates an activation buffer.
+
+TPU: JAX PRNG keys are explicit values, so the whole CUDA state-juggling
+apparatus reduces to key folding:
+
+- per-rank divergence = ``fold_in(key, axis_index(axis))``;
+- deterministic recompute under ``jax.checkpoint`` is automatic because
+  the key is an argument (no state to snapshot/restore);
+- the activation memory buffer is XLA's job (rematerialization policies).
+
+The tracker class is kept for API parity with Megatron-style code.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+
+from apex_tpu.transformer import parallel_state as ps
+
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"
+
+
+def model_parallel_rng_key(key, axis_name: str = ps.TENSOR_AXIS):
+    """Key that differs per tensor-parallel rank (the
+    ``model_parallel_cuda_manual_seed`` offset, ``random.py:207-239``:
+    seed + 2718 + tp_rank)."""
+    try:
+        return jax.random.fold_in(key, jax.lax.axis_index(axis_name))
+    except NameError:
+        return key
+
+
+class RngStateTracker:
+    """Named-key tracker mirroring ``CudaRNGStatesTracker`` (:131-206).
+
+    ``add(name, key)`` registers a stream; ``fork(name)`` yields a fresh
+    subkey each use (the analog of forking the CUDA RNG state) and
+    advances the stream.
+    """
+
+    def __init__(self):
+        self.states_ = {}
+
+    def reset(self):
+        self.states_ = {}
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name: str, key_or_seed):
+        if name in self.states_:
+            raise RuntimeError(f"rng state {name} already exists")
+        key = (jax.random.PRNGKey(key_or_seed)
+               if isinstance(key_or_seed, int) else key_or_seed)
+        self.states_[name] = key
+
+    @contextlib.contextmanager
+    def fork(self, name: str = _MODEL_PARALLEL_RNG_TRACKER_NAME):
+        if name not in self.states_:
+            raise RuntimeError(f"rng state {name} is not added")
+        key, next_key = jax.random.split(self.states_[name])
+        self.states_[name] = next_key
+        yield key
+
+
+_RNG_STATE_TRACKER = RngStateTracker()
+
+
+def get_rng_state_tracker() -> RngStateTracker:
+    """``get_cuda_rng_tracker`` parity (``random.py:194-206``)."""
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_seed(seed: int, axis_name: str = ps.TENSOR_AXIS):
+    """Install the default tracker streams from a base seed
+    (``model_parallel_cuda_manual_seed``, ``random.py:207-239``)."""
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add("global", seed)
+    _RNG_STATE_TRACKER.add(_MODEL_PARALLEL_RNG_TRACKER_NAME,
+                           model_parallel_rng_key(jax.random.PRNGKey(seed + 2718), axis_name))
+
+
+def checkpoint(function, *args, policy=None, prevent_cse: bool = True):
+    """Activation checkpointing (``CheckpointFunction``, ``random.py:241-311``).
+
+    ``jax.checkpoint`` recomputes ``function`` in the backward pass;
+    determinism of any PRNG use inside is guaranteed because keys are
+    explicit arguments. ``policy`` is a ``jax.checkpoint_policies`` entry
+    (e.g. ``dots_with_no_batch_dims_saveable``) replacing the reference's
+    coarse activation-buffer knob (``memory.py``).
+    """
+    fn = jax.checkpoint(function, policy=policy, prevent_cse=prevent_cse)
+    return fn(*args)

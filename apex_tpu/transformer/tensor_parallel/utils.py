@@ -1,0 +1,42 @@
+"""TP utility helpers.
+
+Reference: ``apex/transformer/tensor_parallel/utils.py`` (divide,
+split_tensor_along_last_dim, VocabUtility).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def ensure_divisibility(numerator: int, denominator: int):
+    if numerator % denominator != 0:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(tensor, num_partitions: int):
+    """Split along the last dim into equal chunks
+    (``utils.py split_tensor_along_last_dim``)."""
+    last = tensor.shape[-1]
+    size = divide(last, num_partitions)
+    return [tensor[..., i * size:(i + 1) * size] for i in range(num_partitions)]
+
+
+class VocabUtility:
+    """Padded-vocab shard index math
+    (``apex/transformer/tensor_parallel/utils.py VocabUtility``)."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(per_partition_vocab_size, rank, world_size=None):
+        f = rank * per_partition_vocab_size
+        return f, f + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(global_vocab_size, rank, world_size):
+        per = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(per, rank, world_size)

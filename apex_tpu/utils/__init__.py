@@ -1,0 +1,9 @@
+"""Shared utilities: pytree/dtype helpers used across apex_tpu."""
+
+from apex_tpu.utils.tree import (  # noqa: F401
+    cast_floating,
+    tree_all_finite,
+    tree_map_with_path_names,
+    is_floating,
+)
+from apex_tpu.utils.flat import FlatBuffer, flatten_tensors, unflatten_tensors  # noqa: F401

@@ -1,0 +1,74 @@
+"""Flat-buffer utilities: the TPU analog of ``apex_C.flatten/unflatten``.
+
+The reference flattens bucket tensor lists into one contiguous buffer so a
+single NCCL call / CUDA kernel covers many small tensors
+(``csrc/flatten_unflatten.cpp:1-18``, used by
+``apex/parallel/distributed.py:426``). On TPU the same trick pays off for a
+different reason: one large 1-D array gives XLA a single fused elementwise
+loop (optimizer update, scaling) and a single collective instead of
+hundreds of tiny ones.
+
+``FlatBuffer`` captures the static structure (shapes/sizes/offsets) once so
+the pack/unpack is cheap to retrace and fully shape-static under ``jit``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class FlatBuffer:
+    """Static description of a flattening of a pytree of arrays."""
+
+    treedef: Any
+    shapes: tuple[tuple[int, ...], ...]
+    dtypes: tuple[Any, ...]
+    sizes: tuple[int, ...]
+    offsets: tuple[int, ...]  # start offset of each leaf in the flat buffer
+    total: int
+
+    @staticmethod
+    def from_tree(tree: Any) -> "FlatBuffer":
+        leaves, treedef = jax.tree.flatten(tree)
+        shapes = tuple(tuple(x.shape) for x in leaves)
+        dtypes = tuple(x.dtype for x in leaves)
+        sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+        offsets = tuple(int(o) for o in np.cumsum((0,) + sizes)[:-1])
+        return FlatBuffer(treedef, shapes, dtypes, sizes, offsets, int(sum(sizes)))
+
+    def pack(self, tree: Any, dtype: Any = None) -> jax.Array:
+        """Concatenate all leaves into one 1-D array (optionally casting)."""
+        leaves = jax.tree.leaves(tree)
+        parts = [x.reshape(-1) for x in leaves]
+        if dtype is not None:
+            parts = [p.astype(dtype) for p in parts]
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    def unpack(self, flat: jax.Array, dtype_from_spec: bool = True) -> Any:
+        """Split a flat buffer back into the original pytree."""
+        leaves = []
+        for shape, dt, size, off in zip(self.shapes, self.dtypes, self.sizes, self.offsets):
+            part = jax.lax.dynamic_slice_in_dim(flat, off, size).reshape(shape)
+            if dtype_from_spec:
+                part = part.astype(dt)
+            leaves.append(part)
+        return jax.tree.unflatten(self.treedef, leaves)
+
+
+def flatten_tensors(tensors: Sequence[jax.Array]) -> jax.Array:
+    """``apex_C.flatten`` equivalent: list of arrays -> one 1-D array."""
+    return jnp.concatenate([t.reshape(-1) for t in tensors])
+
+
+def unflatten_tensors(flat: jax.Array, like: Sequence[jax.Array]) -> list[jax.Array]:
+    """``apex_C.unflatten`` equivalent: split ``flat`` to match ``like``."""
+    sizes = [int(np.prod(t.shape)) if t.shape else 1 for t in like]
+    splits = list(np.cumsum(sizes)[:-1])
+    parts = jnp.split(flat, splits)
+    return [p.reshape(t.shape) for p, t in zip(parts, like)]

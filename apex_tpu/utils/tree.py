@@ -1,0 +1,73 @@
+"""Pytree + dtype helpers.
+
+These replace the host-side tensor bookkeeping the reference does with
+python loops over ``torch.nn.Module`` state (e.g.
+``apex/fp16_utils/fp16util.py:60`` ``convert_network``) with pure pytree
+transforms.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def is_floating(x: Any) -> bool:
+    """True if ``x`` is a floating-point JAX/numpy array."""
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def cast_floating(tree: Any, dtype: Any, predicate: Callable[..., bool] | None = None) -> Any:
+    """Cast every floating leaf of ``tree`` to ``dtype``.
+
+    ``predicate(path_names, leaf) -> bool`` can exempt leaves (returning
+    False keeps the leaf untouched) — used for ``keep_batchnorm_fp32``
+    semantics (reference: ``apex/fp16_utils/fp16util.py:60-77`` keeps
+    ``_BatchNorm`` modules in fp32 while halving the rest).
+    """
+    if predicate is None:
+        return jax.tree.map(lambda x: x.astype(dtype) if is_floating(x) else x, tree)
+
+    def _cast(path, x):
+        names = _path_names(path)
+        if is_floating(x) and predicate(names, x):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map_with_path(_cast, tree)
+
+
+def _path_names(path) -> tuple[str, ...]:
+    names = []
+    for p in path:
+        if hasattr(p, "key"):
+            names.append(str(p.key))
+        elif hasattr(p, "name"):
+            names.append(str(p.name))
+        elif hasattr(p, "idx"):
+            names.append(str(p.idx))
+        else:
+            names.append(str(p))
+    return tuple(names)
+
+
+def tree_map_with_path_names(fn: Callable, tree: Any) -> Any:
+    """``jax.tree_util.tree_map_with_path`` but passing string path tuples."""
+    return jax.tree_util.tree_map_with_path(lambda p, x: fn(_path_names(p), x), tree)
+
+
+def tree_all_finite(tree: Any) -> jax.Array:
+    """Single boolean: are ALL floating leaves finite?
+
+    The TPU equivalent of the reference's on-device overflow ``noop_flag``
+    set by every multi-tensor kernel (``csrc/multi_tensor_scale_kernel.cu``):
+    a pure reduction that stays on device; the caller decides when (if
+    ever) to sync it to the host.
+    """
+    leaves = [x for x in jax.tree.leaves(tree) if is_floating(x)]
+    if not leaves:
+        return jnp.asarray(True)
+    finite = [jnp.all(jnp.isfinite(x)) for x in leaves]
+    return jnp.stack(finite).all()

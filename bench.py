@@ -1,0 +1,100 @@
+"""Benchmark: ResNet-50 amp-O2 training throughput on one chip.
+
+BASELINE.md headline: ImageNet RN50 imgs/sec/chip at O2. The reference
+publishes no numbers (BASELINE.json ``published: {}``), so
+``vs_baseline`` reports the O2-vs-O0 speedup on the same hardware — the
+quantity apex exists to maximize (mixed-precision speedup over fp32).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def _build_step(opt_level: str):
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu import amp
+    from apex_tpu.optimizers import FusedSGD
+    from apex_tpu.models import ResNet50
+    from apex_tpu.ops import softmax_cross_entropy_with_smoothing
+
+    model = ResNet50(num_classes=1000,
+                     dtype=jnp.bfloat16 if opt_level in ("O2", "O3") else jnp.float32)
+    amp_model, opt = amp.initialize(
+        lambda v, x: model.apply(v, x, train=True, mutable=["batch_stats"]),
+        FusedSGD(lr=0.1, momentum=0.9, weight_decay=1e-4),
+        opt_level=opt_level, verbosity=0)
+
+    key = jax.random.PRNGKey(0)
+    batch = 128
+    x = jax.random.normal(key, (batch, 224, 224, 3), jnp.float32)
+    y = jax.random.randint(key, (batch,), 0, 1000)
+    variables = model.init(key, x[:2], train=True)
+    variables = amp_model.cast_params(variables)
+    opt_state = opt.init(variables["params"])
+    scaler = opt._amp_stash.loss_scalers[0]
+
+    def loss_fn(params, batch_stats, x, y):
+        (logits, updates) = amp_model(
+            {"params": params, "batch_stats": batch_stats}, x)
+        loss = jnp.mean(softmax_cross_entropy_with_smoothing(logits, y, 0.1))
+        return loss, updates["batch_stats"]
+
+    from apex_tpu.amp import scaler as scaler_mod
+
+    @jax.jit
+    def step(params, batch_stats, opt_state, sstate, x, y):
+        grads, (loss, new_stats) = jax.grad(
+            lambda p: (lambda l, s: (scaler_mod.scale_value(l, sstate), (l, s)))(
+                *loss_fn(p, batch_stats, x, y)), has_aux=True)(params)
+        grads, found_inf = scaler_mod.unscale(grads, sstate)
+        new_params, new_opt_state = opt.apply(opt_state, params, grads, skip=found_inf)
+        new_sstate = scaler.update_state(sstate, found_inf)
+        return new_params, new_stats, new_opt_state, new_sstate, loss
+
+    return (step, variables["params"], variables["batch_stats"], opt_state,
+            scaler.state, x, y, batch)
+
+
+def _time_steps(opt_level: str, warmup: int, iters: int):
+    step, params, stats, opt_state, sstate, x, y, batch = _build_step(opt_level)
+    for _ in range(warmup):
+        params, stats, opt_state, sstate, loss = step(
+            params, stats, opt_state, sstate, x, y)
+    loss.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, stats, opt_state, sstate, loss = step(
+            params, stats, opt_state, sstate, x, y)
+    loss.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    return batch / dt, dt
+
+
+def main():
+    try:
+        o2_ips, o2_dt = _time_steps("O2", warmup=3, iters=20)
+        o0_ips, _ = _time_steps("O0", warmup=2, iters=8)
+        print(json.dumps({
+            "metric": "resnet50_O2_train_throughput",
+            "value": round(o2_ips, 2),
+            "unit": "imgs/sec/chip",
+            "vs_baseline": round(o2_ips / o0_ips, 3),
+        }))
+    except Exception as e:  # still emit the contract line on failure
+        print(json.dumps({
+            "metric": "resnet50_O2_train_throughput",
+            "value": 0.0,
+            "unit": "imgs/sec/chip",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}"[:300],
+        }))
+        raise
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""Test harness config: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's testing doctrine (SURVEY §4): distributed code
+paths are exercised in CI without real multi-chip hardware — apex fakes
+multi-node at world_size=1 over NCCL
+(``apex/transformer/tensor_parallel/tests/commons.py:45-78``); here we
+fake an 8-chip mesh with XLA host devices, which runs the *real* collective
+code.
+"""
+
+import os
+
+# Force CPU: tests must exercise the 8-device virtual mesh, never the
+# (single) real TPU chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,185 @@
+"""amp frontend/policy tests.
+
+Mirrors ``tests/L0/run_amp``: opt-level property defaults + overrides
+(test_basic_casts-style dtype expectations through the O1 policy),
+keep_batchnorm_fp32 exemption, checkpointing of scaler state, and the
+end-to-end jitted train step with overflow skip.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import apex_tpu.amp as amp
+from apex_tpu.optimizers import FusedSGD, FusedAdam
+
+
+def _mlp_apply(params, x):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def _mlp_params(key=0):
+    rng = np.random.RandomState(key)
+    return {
+        "w1": jnp.asarray(rng.randn(4, 8) * 0.5, jnp.float32),
+        "b1": jnp.zeros((8,), jnp.float32),
+        "w2": jnp.asarray(rng.randn(8, 2) * 0.5, jnp.float32),
+        "b2": jnp.zeros((2,), jnp.float32),
+    }
+
+
+def test_opt_level_defaults():
+    m = amp.initialize(_mlp_apply, opt_level="O2")
+    assert m.properties.opt_level == "O2"
+    assert m.properties.cast_model_type == jnp.bfloat16
+    assert m.properties.keep_batchnorm_fp32 is True
+    assert m.properties.master_weights is True
+    assert m.properties.loss_scale == 1.0  # bf16 needs no scaling
+
+    m = amp.initialize(_mlp_apply, opt_level="O2", half_dtype=jnp.float16)
+    assert m.properties.loss_scale == "dynamic"
+
+    m = amp.initialize(_mlp_apply, opt_level="O1")
+    assert m.properties.cast_ops and m.properties.cast_model_type is None
+
+    m = amp.initialize(_mlp_apply, opt_level="O0")
+    assert m.properties.cast_model_type == jnp.float32
+
+    m = amp.initialize(_mlp_apply, opt_level="O3", half_dtype=jnp.float16)
+    assert m.properties.cast_model_type == jnp.float16
+    assert m.properties.master_weights is False
+
+
+def test_invalid_opt_level():
+    with pytest.raises(RuntimeError):
+        amp.initialize(_mlp_apply, opt_level="O4")
+
+
+def test_overrides_win():
+    m = amp.initialize(_mlp_apply, opt_level="O2", loss_scale=512.0,
+                       keep_batchnorm_fp32=False)
+    assert m.properties.loss_scale == 512.0
+    assert m.properties.keep_batchnorm_fp32 is False
+
+
+def test_cast_params_keep_bn_fp32():
+    params = {
+        "Dense_0": {"kernel": jnp.zeros((3, 3), jnp.float32)},
+        "BatchNorm_0": {"scale": jnp.ones((3,), jnp.float32),
+                        "bias": jnp.zeros((3,), jnp.float32)},
+    }
+    m = amp.initialize(_mlp_apply, opt_level="O2")
+    cast = m.cast_params(params)
+    assert cast["Dense_0"]["kernel"].dtype == jnp.bfloat16
+    assert cast["BatchNorm_0"]["scale"].dtype == jnp.float32
+
+    m3 = amp.initialize(_mlp_apply, opt_level="O3")
+    cast3 = m3.cast_params(params)
+    assert cast3["BatchNorm_0"]["scale"].dtype == jnp.bfloat16
+
+
+def test_forward_casts_inputs_o2():
+    traced_dtypes = {}
+
+    def probe(params, x):
+        traced_dtypes["x"] = x.dtype
+        return x.sum()
+
+    m = amp.initialize(probe, opt_level="O2")
+    out = m({}, jnp.ones((4,), jnp.float32))
+    assert traced_dtypes["x"] == jnp.bfloat16
+    assert out.dtype == jnp.float32  # outputs cast back
+
+
+def test_o1_policy_casts_registered_fns():
+    from apex_tpu.ops.dense import linear_bias
+    m = amp.initialize(lambda p, x: x, opt_level="O1")
+    x = jnp.ones((2, 4), jnp.float32)
+    w = jnp.ones((3, 4), jnp.float32)
+    b = jnp.zeros((3,), jnp.float32)
+    with amp.autocast(True, jnp.bfloat16):
+        y = linear_bias(x, w, b)
+    assert y.dtype == jnp.bfloat16
+    y = linear_bias(x, w, b)  # outside autocast: untouched
+    assert y.dtype == jnp.float32
+
+
+def test_promote_and_float_functions():
+    @amp.promote_function
+    def add(a, b):
+        return a + b
+
+    @amp.float_function
+    def f32_only(a):
+        return a
+
+    with amp.autocast(True, jnp.bfloat16):
+        out = add(jnp.ones(3, jnp.bfloat16), jnp.ones(3, jnp.float32))
+        assert out.dtype == jnp.float32
+        assert f32_only(jnp.ones(3, jnp.bfloat16)).dtype == jnp.float32
+
+
+def test_state_dict_roundtrip():
+    model, opt = amp.initialize(_mlp_apply, FusedSGD(lr=0.1),
+                                opt_level="O2", half_dtype=jnp.float16)
+    sd = amp.state_dict()
+    assert "loss_scaler0" in sd
+    sd["loss_scaler0"]["loss_scale"] = 42.0
+    amp.load_state_dict(sd)
+    assert amp.frontend._amp_state.loss_scalers[0].loss_scale() == 42.0
+
+
+def test_train_step_decreases_loss():
+    params = _mlp_params()
+    model, opt = amp.initialize(_mlp_apply, FusedAdam(lr=5e-2), opt_level="O2")
+    params = model.cast_params(params)
+    opt_state = opt.init(params)
+    scaler = opt._amp_stash.loss_scalers[0]
+
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 4), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(1).randn(16, 2), jnp.float32)
+
+    def loss_fn(p, x, y):
+        pred = model(p, x)
+        return jnp.mean((pred - y) ** 2)
+
+    step = amp.make_train_step(loss_fn, opt, scaler=scaler)
+    sstate = scaler.state
+    losses = []
+    for _ in range(30):
+        params, opt_state, sstate, loss = step(params, opt_state, sstate, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_train_step_overflow_skips_and_rescales():
+    params = _mlp_params()
+    model, opt = amp.initialize(_mlp_apply, FusedSGD(lr=0.1),
+                                opt_level="O2", half_dtype=jnp.float16)
+    params = model.cast_params(params)
+    opt_state = opt.init(params)
+    scaler = opt._amp_stash.loss_scalers[0]
+    assert scaler.dynamic
+
+    def loss_fn(p, x):
+        # overflow factory: product grows way past fp16 range in grads
+        return jnp.sum(p["w1"].astype(jnp.float32) * 1e30) * jnp.sum(x)
+
+    step = amp.make_train_step(loss_fn, opt, scaler=scaler)
+    x = jnp.ones((2,), jnp.float32)
+    before = jax.tree.map(np.asarray, params)
+    s0 = float(scaler.state.loss_scale)
+    params2, opt_state, sstate, _ = step(params, opt_state, scaler.state, x)
+    # inf grads → step skipped, scale halved
+    for k in before:
+        np.testing.assert_array_equal(np.asarray(params2[k]), before[k])
+    assert float(sstate.loss_scale) == s0 / 2
+
+
+def test_scale_loss_context_manager():
+    model, opt = amp.initialize(_mlp_apply, FusedSGD(lr=0.1),
+                                opt_level="O2", half_dtype=jnp.float16)
+    with amp.scale_loss(jnp.asarray(2.0), opt) as scaled:
+        assert float(scaled) == 2.0 * 2.0 ** 16

@@ -1,0 +1,163 @@
+"""Fused layer parity tests (LayerNorm, RMSNorm, softmax, dense, MLP, xentropy).
+
+Mirrors ``tests/L0/run_fused_layer_norm/test_fused_layer_norm.py`` and
+``tests/L0/run_mlp/test_mlp.py`` + contrib tests: each fused op is checked
+against a naive jnp composition for values AND gradients.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops import (
+    fused_layer_norm, fused_layer_norm_affine, fused_rms_norm_affine,
+    scaled_masked_softmax, scaled_upper_triang_masked_softmax,
+    softmax_cross_entropy_with_smoothing, linear_bias, linear_gelu_linear,
+    mlp_forward)
+
+
+def _naive_ln(x, w, b, eps):
+    mean = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + eps) * w + b
+
+
+@pytest.mark.parametrize("shape", [(4, 16), (2, 3, 32)])
+def test_layer_norm_affine_parity(shape):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*shape), jnp.float32)
+    w = jnp.asarray(rng.randn(shape[-1]), jnp.float32)
+    b = jnp.asarray(rng.randn(shape[-1]), jnp.float32)
+
+    y = fused_layer_norm_affine(x, w, b, (shape[-1],), 1e-5)
+    ref = _naive_ln(x, w, b, 1e-5)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    # gradient parity
+    f1 = lambda x, w, b: jnp.sum(jnp.sin(fused_layer_norm_affine(x, w, b, (shape[-1],), 1e-5)))
+    f2 = lambda x, w, b: jnp.sum(jnp.sin(_naive_ln(x, w, b, 1e-5)))
+    g1 = jax.grad(f1, argnums=(0, 1, 2))(x, w, b)
+    g2 = jax.grad(f2, argnums=(0, 1, 2))(x, w, b)
+    for a, r in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r), rtol=1e-4, atol=1e-5)
+
+
+def test_layer_norm_no_affine_grad():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(6, 24), jnp.float32)
+    f1 = lambda x: jnp.sum(fused_layer_norm(x, (24,), 1e-5) ** 2)
+    f2 = lambda x: jnp.sum((( x - jnp.mean(x, -1, keepdims=True)) / jnp.sqrt(jnp.var(x, -1, keepdims=True) + 1e-5)) ** 2)
+    np.testing.assert_allclose(np.asarray(jax.grad(f1)(x)), np.asarray(jax.grad(f2)(x)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_mixed_dtype_layer_norm():
+    """bf16 input + bf16 weights → bf16 out (MixedFused semantics)."""
+    x = jnp.ones((4, 8), jnp.bfloat16)
+    w = jnp.ones((8,), jnp.bfloat16)
+    b = jnp.zeros((8,), jnp.bfloat16)
+    y = fused_layer_norm_affine(x, w, b, (8,), 1e-5)
+    assert y.dtype == jnp.bfloat16
+    # bf16 input + fp32 weights → fp32 out (forward_affine_mixed_dtypes)
+    y2 = fused_layer_norm_affine(x, w.astype(jnp.float32), b.astype(jnp.float32), (8,), 1e-5)
+    assert y2.dtype == jnp.float32
+
+
+def test_rms_norm_parity():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(5, 16), jnp.float32)
+    w = jnp.asarray(rng.randn(16), jnp.float32)
+    y = fused_rms_norm_affine(x, w, (16,), 1e-6)
+    ref = x / jnp.sqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6) * w
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-5)
+    g1 = jax.grad(lambda x, w: jnp.sum(jnp.cos(fused_rms_norm_affine(x, w, (16,), 1e-6))), (0, 1))(x, w)
+    g2 = jax.grad(lambda x, w: jnp.sum(jnp.cos(x / jnp.sqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6) * w)), (0, 1))(x, w)
+    for a, r in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r), rtol=1e-4, atol=1e-5)
+
+
+def test_scaled_masked_softmax():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(2, 4, 8, 8), jnp.float32)
+    mask = jnp.asarray(rng.rand(2, 1, 8, 8) > 0.7)
+    scale = 0.5
+    y = scaled_masked_softmax(x, mask, scale)
+    ref = jax.nn.softmax(jnp.where(mask, -10000.0, x * scale), axis=-1)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-6)
+    g1 = jax.grad(lambda x: jnp.sum(scaled_masked_softmax(x, mask, scale) ** 2))(x)
+    g2 = jax.grad(lambda x: jnp.sum(ref_fn(x, mask, scale) ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-6)
+
+
+def ref_fn(x, mask, scale):
+    return jax.nn.softmax(jnp.where(mask, -10000.0, x * scale), axis=-1)
+
+
+def test_causal_softmax():
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(3, 8, 8), jnp.float32)
+    y = scaled_upper_triang_masked_softmax(x, 1.0)
+    mask = np.triu(np.ones((8, 8), bool), k=1)
+    ref = jax.nn.softmax(jnp.where(jnp.asarray(mask), -10000.0, x), axis=-1)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-6)
+    # rows attend only to the past
+    assert float(y[0, 0, 1]) < 1e-4
+
+
+def test_xentropy_parity_and_grad():
+    rng = np.random.RandomState(5)
+    logits = jnp.asarray(rng.randn(6, 11), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 11, size=(6,)))
+
+    def ref(logits, labels, smoothing):
+        logp = jax.nn.log_softmax(logits, -1)
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+        smooth = -jnp.mean(logp, -1)
+        return (1 - smoothing) * nll + smoothing * smooth
+
+    for smoothing in (0.0, 0.1):
+        y = softmax_cross_entropy_with_smoothing(logits, labels, smoothing)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref(logits, labels, smoothing)),
+                                   rtol=1e-5, atol=1e-6)
+        g1 = jax.grad(lambda l: jnp.sum(softmax_cross_entropy_with_smoothing(l, labels, smoothing)))(logits)
+        g2 = jax.grad(lambda l: jnp.sum(ref(l, labels, smoothing)))(logits)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-6)
+
+
+def test_xentropy_padding():
+    logits = jnp.zeros((3, 5))
+    labels = jnp.asarray([1, 0, 0])
+    y = softmax_cross_entropy_with_smoothing(logits, labels, 0.0, padding_idx=0)
+    assert float(y[1]) == 0.0 and float(y[2]) == 0.0 and float(y[0]) > 0
+
+
+def test_linear_bias_and_gelu():
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(4, 8), jnp.float32)
+    w1 = jnp.asarray(rng.randn(16, 8) * 0.1, jnp.float32)
+    b1 = jnp.asarray(rng.randn(16) * 0.1, jnp.float32)
+    w2 = jnp.asarray(rng.randn(8, 16) * 0.1, jnp.float32)
+    b2 = jnp.asarray(rng.randn(8) * 0.1, jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(linear_bias(x, w1, b1)), np.asarray(x @ w1.T + b1), rtol=1e-5, atol=1e-5)
+    ref = jax.nn.gelu(x @ w1.T + b1, approximate=False) @ w2.T + b2
+    np.testing.assert_allclose(
+        np.asarray(linear_gelu_linear(x, w1, b1, w2, b2)), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_mlp_vs_sequential():
+    """apex tests MLP vs nn.Sequential (tests/L0/run_mlp/test_mlp.py)."""
+    rng = np.random.RandomState(7)
+    sizes = [8, 16, 4]
+    x = jnp.asarray(rng.randn(5, 8), jnp.float32)
+    ws = [jnp.asarray(rng.randn(sizes[i + 1], sizes[i]) * 0.3, jnp.float32) for i in range(2)]
+    bs = [jnp.asarray(rng.randn(sizes[i + 1]) * 0.1, jnp.float32) for i in range(2)]
+    y = mlp_forward(x, ws, bs, "relu")
+    h = x
+    for w, b in zip(ws, bs):
+        h = jax.nn.relu(h @ w.T + b)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(h), rtol=1e-5, atol=1e-5)
+    # grads flow
+    g = jax.grad(lambda ws: jnp.sum(mlp_forward(x, ws, bs, "relu")))(ws)
+    assert all(np.isfinite(np.asarray(gi)).all() for gi in g)

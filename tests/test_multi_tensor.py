@@ -1,0 +1,64 @@
+"""Multi-tensor op parity tests.
+
+Mirrors ``tests/L0/run_amp/test_multi_tensor_scale.py`` /
+``test_multi_tensor_axpby.py`` / ``test_multi_tensor_l2norm.py``:
+elementwise parity against naive ops plus inf/nan injection at tensor
+boundaries flips the overflow flag.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.multi_tensor_apply import (
+    multi_tensor_scale, multi_tensor_axpby, multi_tensor_l2norm)
+
+
+def _mklist(sizes, dtype=jnp.float32, val=None):
+    out = []
+    for i, s in enumerate(sizes):
+        a = jnp.arange(s, dtype=jnp.float32) * (i + 1) * 0.25 - 3.0
+        out.append((a if val is None else jnp.full((s,), val)).astype(dtype))
+    return out
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.float16, jnp.bfloat16])
+def test_scale_parity(dtype):
+    srcs = _mklist([7, 33, 128], dtype)
+    outs, found = multi_tensor_scale(srcs, 0.125)
+    assert not bool(found)
+    for s, o in zip(srcs, outs):
+        np.testing.assert_allclose(
+            np.asarray(o, np.float32),
+            np.asarray(s, np.float32) * 0.125, rtol=1e-2)
+        assert o.dtype == dtype
+
+
+@pytest.mark.parametrize("bad", [np.inf, -np.inf, np.nan])
+@pytest.mark.parametrize("pos", [0, 2])
+def test_scale_overflow_flag(bad, pos):
+    srcs = _mklist([5, 9, 17])
+    srcs[pos] = srcs[pos].at[-1].set(bad)
+    _, found = multi_tensor_scale(srcs, 1.0)
+    assert bool(found)
+
+
+def test_axpby_parity_and_flag():
+    xs = _mklist([11, 64])
+    ys = _mklist([11, 64])
+    outs, found = multi_tensor_axpby(xs, ys, 2.0, -0.5)
+    assert not bool(found)
+    for x, y, o in zip(xs, ys, outs):
+        np.testing.assert_allclose(np.asarray(o), 2.0 * np.asarray(x) - 0.5 * np.asarray(y), rtol=1e-6)
+    ys[1] = ys[1].at[0].set(np.nan)
+    _, found = multi_tensor_axpby(xs, ys, 2.0, -0.5)
+    assert bool(found)
+
+
+def test_l2norm_global_and_per_tensor():
+    ts = _mklist([13, 57, 256])
+    norm, per = multi_tensor_l2norm(ts, per_tensor=True)
+    ref = np.sqrt(sum(float(np.sum(np.asarray(t) ** 2)) for t in ts))
+    np.testing.assert_allclose(float(norm), ref, rtol=1e-6)
+    for t, p in zip(ts, per):
+        np.testing.assert_allclose(float(p), np.linalg.norm(np.asarray(t)), rtol=1e-6)

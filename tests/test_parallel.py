@@ -1,0 +1,166 @@
+"""Data-parallel + SyncBN tests on the 8-device virtual CPU mesh.
+
+Mirrors ``tests/distributed/`` (DDP grad-value verification, SyncBN vs
+full-batch BN incl. group support) but runs the real collective code via
+``shard_map`` over host devices (SURVEY §4 testing doctrine (b)/(c)).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from apex_tpu.parallel import (
+    allreduce_gradients, DistributedDataParallel, SyncBatchNorm,
+    create_syncbn_process_group)
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()), ("data",))
+
+
+def test_allreduce_gradients_average():
+    mesh = _mesh()
+    n = len(jax.devices())
+    grads = {"w": jnp.arange(n * 4, dtype=jnp.float32).reshape(n, 4)}
+
+    f = shard_map(
+        lambda g: allreduce_gradients(g, "data"),
+        mesh=mesh, in_specs=(P("data"),), out_specs=P("data"))
+    out = f(grads)
+    expect = np.mean(np.arange(n * 4, dtype=np.float32).reshape(n, 4), axis=0)
+    for i in range(n):
+        np.testing.assert_allclose(np.asarray(out["w"][i]), expect, rtol=1e-6)
+
+
+def test_allreduce_predivide_matches_average():
+    mesh = _mesh()
+    n = len(jax.devices())
+    g = jnp.arange(n * 2, dtype=jnp.float32).reshape(n, 2)
+    f1 = shard_map(lambda g: allreduce_gradients(g, "data"),
+                   mesh=mesh, in_specs=(P("data"),), out_specs=P("data"))
+    f2 = shard_map(
+        lambda g: allreduce_gradients(g, "data", gradient_predivide_factor=float(n)),
+        mesh=mesh, in_specs=(P("data"),), out_specs=P("data"))
+    np.testing.assert_allclose(np.asarray(f1(g)), np.asarray(f2(g)), rtol=1e-6)
+
+
+def test_allreduce_fp32_upcast_path():
+    mesh = _mesh()
+    n = len(jax.devices())
+    g = jnp.ones((n, 3), jnp.bfloat16)
+    f = shard_map(
+        lambda g: allreduce_gradients(g, "data", allreduce_always_fp32=True),
+        mesh=mesh, in_specs=(P("data"),), out_specs=P("data"))
+    out = f(g)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32), 1.0)
+
+
+def test_ddp_wrapper_delay_allreduce():
+    ddp = DistributedDataParallel(lambda p, x: x, delay_allreduce=True)
+    g = {"w": jnp.ones((2,))}
+    assert ddp.sync(g) is g  # no-op until flush
+
+
+def test_syncbn_matches_full_batch_bn():
+    """Split batch across 8 devices; SyncBN must equal single-device BN on
+    the full batch (tests/distributed/synced_batchnorm doctrine)."""
+    mesh = _mesh()
+    n = len(jax.devices())
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(n * 4, 6), jnp.float32)
+
+    bn = SyncBatchNorm(num_features=6, axis_name="data")
+    vars_ = bn.init(jax.random.PRNGKey(0), x[:4])
+
+    def fwd(x):
+        y, updates = bn.apply(vars_, x, mutable=["batch_stats"])
+        return y, updates["batch_stats"]
+
+    f = shard_map(fwd, mesh=mesh, in_specs=(P("data"),),
+                  out_specs=(P("data"), P()))
+    y, stats = f(x)
+
+    # reference: plain BN on the full batch
+    mean = np.mean(np.asarray(x), 0)
+    var = np.var(np.asarray(x), 0)
+    ref = (np.asarray(x) - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-5)
+
+    # running stats updated with global statistics (count-weighted)
+    np.testing.assert_allclose(np.asarray(stats["mean"]), 0.1 * mean, rtol=1e-4, atol=1e-5)
+
+
+def test_syncbn_gradients_match_full_batch():
+    mesh = _mesh()
+    n = len(jax.devices())
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(n * 2, 4), jnp.float32)
+    bn = SyncBatchNorm(num_features=4, axis_name="data")
+    vars_ = bn.init(jax.random.PRNGKey(0), x[:2])
+
+    def loss_sharded(x):
+        def inner(x):
+            y, _ = bn.apply(vars_, x, mutable=["batch_stats"])
+            local = jnp.sum(jnp.sin(y))
+            return jax.lax.psum(local, "data")
+        f = shard_map(inner, mesh=mesh, in_specs=(P("data"),), out_specs=P())
+        return f(x)
+
+    def loss_full(x):
+        bn1 = SyncBatchNorm(num_features=4, axis_name=None)
+        y, _ = bn1.apply(vars_, x, mutable=["batch_stats"])
+        return jnp.sum(jnp.sin(y))
+
+    g1 = jax.grad(loss_sharded)(x)
+    g2 = jax.grad(loss_full)(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-5)
+
+
+def test_syncbn_groups():
+    """Grouped sync: stats shared only within each group of 4
+    (tests/distributed/synced_batchnorm/test_groups.py analog)."""
+    mesh = _mesh()
+    n = len(jax.devices())
+    groups = create_syncbn_process_group(4, n)
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(n, 2, 3), jnp.float32)  # 1 example per device
+
+    bn = SyncBatchNorm(num_features=3, axis_name="data", axis_index_groups=groups)
+    vars_ = bn.init(jax.random.PRNGKey(0), x[0:1])
+
+    def fwd(x):
+        y, _ = bn.apply(vars_, x, mutable=["batch_stats"])
+        return y
+
+    f = shard_map(fwd, mesh=mesh, in_specs=(P("data"),), out_specs=P("data"))
+    y = f(x)
+
+    xa = np.asarray(x)
+    ya = np.asarray(y)
+    for gi, idxs in enumerate(groups):
+        seg = xa[idxs].reshape(-1, 3)
+        mean, var = seg.mean(0), seg.var(0)
+        ref = (xa[idxs] - mean) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(ya[idxs], ref, rtol=1e-4, atol=1e-5)
+
+
+def test_flat_dist_call():
+    from apex_tpu.parallel import flat_dist_call
+    mesh = _mesh()
+    n = len(jax.devices())
+    a = jnp.ones((n, 2))
+    b = jnp.full((n, 3), 2.0)
+
+    def inner(a, b):
+        outs = flat_dist_call([a, b], lambda t: jax.lax.psum(t, "data"))
+        return tuple(outs)
+
+    f = shard_map(inner, mesh=mesh, in_specs=(P("data"), P("data")),
+                  out_specs=(P("data"), P("data")))
+    oa, ob = f(a, b)
+    np.testing.assert_allclose(np.asarray(oa), n * 1.0)
+    np.testing.assert_allclose(np.asarray(ob), n * 2.0)

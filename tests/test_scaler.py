@@ -1,0 +1,68 @@
+"""LossScaler semantics tests.
+
+Mirrors the overflow-handling expectations of apex
+(``apex/amp/scaler.py:197-217``): halve on overflow, double every
+``scale_window`` clean steps, respect min/max clamps; static scaling is
+inert.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.amp import scaler as S
+
+
+def test_dynamic_overflow_halves():
+    st = S.init_state(2.0 ** 16)
+    st = S.update(st, jnp.asarray(True), dynamic=True)
+    assert float(st.loss_scale) == 2.0 ** 15
+    assert int(st.unskipped) == 0
+    assert bool(st.overflow)
+
+
+def test_dynamic_window_doubles():
+    st = S.init_state(1024.0)
+    for _ in range(2000):
+        st = S.update(st, jnp.asarray(False), dynamic=True, scale_window=2000)
+    assert float(st.loss_scale) == 2048.0
+    assert int(st.unskipped) == 0
+
+
+def test_static_scale_unchanged():
+    st = S.init_state(128.0)
+    st2 = S.update(st, jnp.asarray(True), dynamic=False)
+    assert float(st2.loss_scale) == 128.0
+
+
+def test_max_scale_clamp():
+    st = S.init_state(2.0 ** 24)
+    for _ in range(2001):
+        st = S.update(st, jnp.asarray(False), dynamic=True, scale_window=2000)
+    assert float(st.loss_scale) == 2.0 ** 24
+
+
+def test_unscale_detects_inf_and_divides():
+    st = S.init_state(4.0)
+    grads = {"a": jnp.asarray([4.0, 8.0]), "b": jnp.asarray([2.0])}
+    out, found = S.unscale(grads, st)
+    assert not bool(found)
+    np.testing.assert_allclose(np.asarray(out["a"]), [1.0, 2.0])
+    bad = {"a": jnp.asarray([jnp.inf]), "b": jnp.asarray([1.0])}
+    _, found = S.unscale(bad, st)
+    assert bool(found)
+
+
+def test_scale_loss_value():
+    st = S.init_state(8.0)
+    assert float(S.scale_value(jnp.asarray(2.0, jnp.bfloat16), st)) == 16.0
+
+
+def test_stateful_wrapper_and_checkpoint():
+    sc = S.LossScaler("dynamic", init_scale=256.0)
+    skip = sc.update_scale(found_inf=True)
+    assert skip and sc.loss_scale() == 128.0
+    sd = sc.state_dict()
+    sc2 = S.LossScaler("dynamic")
+    sc2.load_state_dict(sd)
+    assert sc2.loss_scale() == 128.0

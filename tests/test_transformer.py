@@ -1,0 +1,218 @@
+"""Transformer TP/PP tests on the 8-device virtual mesh.
+
+Mirrors the reference's mpu test scripts
+(``apex/transformer/tensor_parallel/tests/run_*_test.py`` driven by
+``tests/L0/run_transformer/test_mpu.py``): TP layers and vocab-parallel
+CE must match their dense single-device equivalents bit-for-bit (fp32),
+and the mesh-grid bookkeeping must be consistent.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    vocab_parallel_cross_entropy, mappings, divide)
+from apex_tpu.transformer.pipeline_parallel import (
+    pipeline_apply, forward_backward_no_pipelining)
+
+
+@pytest.fixture
+def tp_mesh():
+    ps.destroy_model_parallel()
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size_=4)
+    yield mesh
+    ps.destroy_model_parallel()
+
+
+def test_grid_init_world_sizes(tp_mesh):
+    assert ps.get_tensor_model_parallel_world_size() == 4
+    assert ps.get_data_parallel_world_size() == 2
+    assert ps.get_pipeline_model_parallel_world_size() == 1
+    assert ps.model_parallel_is_initialized()
+
+
+def test_grid_invalid_factorization():
+    ps.destroy_model_parallel()
+    with pytest.raises(RuntimeError):
+        ps.initialize_model_parallel(tensor_model_parallel_size_=3)
+    ps.destroy_model_parallel()
+
+
+def _run_tp(mesh, fn, *args, in_specs=None, out_specs=P()):
+    """Run fn under shard_map replicated over data, explicit over tensor."""
+    return shard_map(
+        fn, mesh=mesh,
+        in_specs=in_specs or tuple(P() for _ in args),
+        out_specs=out_specs, check_vma=False)(*args)
+
+
+def test_column_parallel_matches_dense(tp_mesh):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(6, 16), jnp.float32)
+    layer = ColumnParallelLinear(input_size=16, output_size=32, gather_output=True)
+
+    def fwd(x):
+        v = layer.init(jax.random.PRNGKey(7), x)
+        return layer.apply(v, x)
+
+    y = _run_tp(tp_mesh, fwd, x)
+
+    # dense reference: same init seed at tp=1
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(tensor_model_parallel_size_=1)
+    v1 = layer.init(jax.random.PRNGKey(7), x)
+    y_ref = layer.apply(v1, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), rtol=1e-5, atol=1e-5)
+
+
+def test_row_parallel_matches_dense(tp_mesh):
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(6, 32), jnp.float32)
+    layer = RowParallelLinear(input_size=32, output_size=16)
+
+    def fwd(x):
+        v = layer.init(jax.random.PRNGKey(3), x)
+        return layer.apply(v, x)
+
+    y = _run_tp(tp_mesh, fwd, x)
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(tensor_model_parallel_size_=1)
+    v1 = layer.init(jax.random.PRNGKey(3), x)
+    y_ref = layer.apply(v1, x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), rtol=1e-4, atol=1e-4)
+
+
+def test_column_into_row_mlp(tp_mesh):
+    """Megatron MLP pattern: Column(gather_output=False) → Row(input_is_parallel)."""
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(4, 8), jnp.float32)
+    col = ColumnParallelLinear(input_size=8, output_size=32, gather_output=False)
+    row = RowParallelLinear(input_size=32, output_size=8, input_is_parallel=True)
+
+    def fwd(x):
+        vc = col.init(jax.random.PRNGKey(0), x)
+        h = col.apply(vc, x)
+        h = jax.nn.gelu(h)
+        vr = row.init(jax.random.PRNGKey(1), h)
+        return row.apply(vr, h)
+
+    y = _run_tp(tp_mesh, fwd, x)
+    assert y.shape == (4, 8)
+    assert np.isfinite(np.asarray(y)).all()
+
+
+def test_vocab_parallel_embedding(tp_mesh):
+    ids = jnp.asarray([[0, 5, 11], [3, 7, 2]])
+    emb = VocabParallelEmbedding(num_embeddings=12, embedding_dim=8)
+
+    def fwd(ids):
+        v = emb.init(jax.random.PRNGKey(11), ids)
+        return emb.apply(v, ids)
+
+    y = _run_tp(tp_mesh, fwd, ids)
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(tensor_model_parallel_size_=1)
+    v1 = emb.init(jax.random.PRNGKey(11), ids)
+    y_ref = emb.apply(v1, ids)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), rtol=1e-5, atol=1e-6)
+
+
+def test_vocab_parallel_cross_entropy(tp_mesh):
+    """3-collective CE on vocab shards == dense CE (cross_entropy.py:23-103)."""
+    rng = np.random.RandomState(3)
+    V = 16
+    logits = jnp.asarray(rng.randn(5, V), jnp.float32)
+    target = jnp.asarray(rng.randint(0, V, (5,)))
+
+    def fwd(logits, target):
+        rank = ps.get_tensor_model_parallel_rank()
+        per = V // 4
+        shard = jax.lax.dynamic_slice_in_dim(logits, rank * per, per, axis=-1)
+        return vocab_parallel_cross_entropy(shard, target)
+
+    loss = _run_tp(tp_mesh, fwd, logits, target)
+    logp = jax.nn.log_softmax(logits, -1)
+    ref = -jnp.take_along_axis(logp, target[:, None], -1)[:, 0]
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+
+def test_vocab_parallel_cross_entropy_grad(tp_mesh):
+    rng = np.random.RandomState(4)
+    V = 8
+    logits = jnp.asarray(rng.randn(3, V), jnp.float32)
+    target = jnp.asarray(rng.randint(0, V, (3,)))
+
+    def loss_sharded(logits):
+        def inner(logits, target):
+            # scatter mapping: bwd all-gathers shard grads into the full
+            # (replicated) logits cotangent — the Megatron "scatter" f/g pair
+            shard = mappings.scatter_to_tensor_model_parallel_region(logits)
+            loss = vocab_parallel_cross_entropy(shard, target)
+            return jnp.sum(loss)
+        return _run_tp(tp_mesh, inner, logits, target)
+
+    def loss_dense(logits):
+        logp = jax.nn.log_softmax(logits, -1)
+        return jnp.sum(-jnp.take_along_axis(logp, target[:, None], -1)[:, 0])
+
+    g1 = jax.grad(loss_sharded)(logits)
+    g2 = jax.grad(loss_dense)(logits)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-5)
+
+
+def test_mappings_roundtrip(tp_mesh):
+    x = jnp.arange(32, dtype=jnp.float32).reshape(4, 8)
+
+    def fwd(x):
+        s = mappings.scatter_to_tensor_model_parallel_region(x)
+        return mappings.gather_from_tensor_model_parallel_region(s)
+
+    y = _run_tp(tp_mesh, fwd, x)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_pipeline_apply_matches_sequential():
+    """GPipe fill-drain over 8 stages == applying all 8 stages in order."""
+    ps.destroy_model_parallel()
+    mesh = ps.initialize_model_parallel(pipeline_model_parallel_size_=8)
+    n_micro, mb, h = 4, 2, 6
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(n_micro, mb, h), jnp.float32)
+    # per-stage params: stage i scales by w[i] (shape [8, h])
+    w = jnp.asarray(rng.rand(8, h) * 0.5 + 0.75, jnp.float32)
+
+    def stage_fn(params, hid):
+        return hid * params
+
+    def run(x, w):
+        outs = pipeline_apply(stage_fn, w[0], x, n_micro)
+        # outputs are zeros on every stage but the last → psum replicates
+        return jax.lax.psum(outs, "pipeline")
+
+    outs = shard_map(run, mesh=mesh,
+                     in_specs=(P(), P("pipeline")), out_specs=P(),
+                     check_vma=False)(x, w)
+    # sequential reference
+    ref = x
+    for i in range(8):
+        ref = ref * w[i]
+    np.testing.assert_allclose(np.asarray(outs), np.asarray(ref), rtol=1e-5, atol=1e-6)
+    ps.destroy_model_parallel()
+
+
+def test_forward_backward_no_pipelining():
+    params = {"w": jnp.asarray(2.0)}
+    batch = jnp.arange(4, dtype=jnp.float32).reshape(4, 1)  # 4 microbatches
+
+    def loss_fn(p, mb):
+        return jnp.sum(p["w"] * mb)
+
+    loss, grads = forward_backward_no_pipelining(loss_fn, params, batch, 4)
+    np.testing.assert_allclose(float(loss), 2.0 * 6.0 / 4)
+    np.testing.assert_allclose(float(grads["w"]), 6.0 / 4)
