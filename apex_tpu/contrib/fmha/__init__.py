@@ -1,0 +1,9 @@
+"""apex_tpu.contrib.fmha — packed variable-length fused attention.
+
+Reference: ``apex/contrib/fmha/fmha.py:32-58`` — ``fmha.fwd(qkv,
+cu_seqlens, p_dropout, max_s, ...)`` on a packed [total, 3, h, d] batch,
+seqlen ≤ 512, sm80-only. TPU: cu_seqlens → segment ids feeding the Pallas
+flash-attention kernel; no seqlen cap, any chip.
+"""
+
+from apex_tpu.contrib.fmha.fmha import fmha_varlen, FMHAFun, cu_seqlens_to_segment_ids  # noqa: F401

@@ -1,0 +1,51 @@
+"""Packed-varlen attention via segment ids.
+
+Reference call shape (``apex/contrib/fmha/fmha.py:32-58``): QKV packed as
+[total_tokens, 3, heads, head_dim] with ``cu_seqlens`` [batch+1]
+prefix-sum boundaries. The CUDA kernels specialize on max seqlen
+(128/256/384/512); the TPU kernel has no such cap — one code path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.flash_attention import flash_attention
+
+
+def cu_seqlens_to_segment_ids(cu_seqlens, total: int):
+    """[b+1] prefix sums -> int32 [total] segment ids (static total)."""
+    return jnp.searchsorted(cu_seqlens[1:], jnp.arange(total), side="right").astype(jnp.int32)
+
+
+def fmha_varlen(qkv, cu_seqlens, *, causal: bool = False,
+                scale: float | None = None, block: int = 128):
+    """qkv: [total, 3, h, d] packed batch. Returns [total, h, d].
+
+    ``total`` should be padded to a block multiple; pad tokens get a
+    segment id of their own trailing segment and attend only themselves
+    (their outputs are garbage to be masked by the caller, same contract
+    as the reference's packed layout).
+    """
+    total, three, h, d = qkv.shape
+    if three != 3:
+        raise ValueError("qkv must be [total, 3, heads, head_dim]")
+    sids = cu_seqlens_to_segment_ids(cu_seqlens, total)[None]  # [1, total]
+    q = qkv[:, 0].transpose(1, 0, 2)[None]   # [1, h, total, d]
+    k = qkv[:, 1].transpose(1, 0, 2)[None]
+    v = qkv[:, 2].transpose(1, 0, 2)[None]
+    out = flash_attention(q, k, v, segment_ids_q=sids, causal=causal,
+                          scale=scale, block_q=min(block, total),
+                          block_k=min(block, total))
+    return out[0].transpose(1, 0, 2)          # [total, h, d]
+
+
+class FMHAFun:
+    """API-parity shim for ``FMHAFun.apply`` (``apex/contrib/fmha/fmha.py:9``)."""
+
+    @staticmethod
+    def apply(qkv, cu_seqlens, p_dropout=0.0, max_s=None, is_training=True,
+              zero_tensors=False):
+        del p_dropout, max_s, is_training, zero_tensors
+        return fmha_varlen(qkv, cu_seqlens)

@@ -1,0 +1,11 @@
+"""apex_tpu.contrib.multihead_attn — fused multihead attention modules.
+
+Reference: ``apex/contrib/multihead_attn/__init__.py`` (SelfMultiheadAttn,
+EncdecMultiheadAttn, MaskSoftmaxDropout) over 8 CUDA variant extensions
+(``apex/contrib/csrc/multihead_attn/*``). Here all variants collapse onto
+one Pallas flash-attention kernel plus fused LN/bias epilogues.
+"""
+
+from apex_tpu.contrib.multihead_attn.self_multihead_attn import SelfMultiheadAttn  # noqa: F401
+from apex_tpu.contrib.multihead_attn.encdec_multihead_attn import EncdecMultiheadAttn  # noqa: F401
+from apex_tpu.contrib.multihead_attn.mask_softmax_dropout import MaskSoftmaxDropout  # noqa: F401

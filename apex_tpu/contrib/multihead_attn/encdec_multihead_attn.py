@@ -1,0 +1,86 @@
+"""Encoder-decoder (cross) multihead attention.
+
+Reference: ``apex/contrib/multihead_attn/encdec_multihead_attn.py`` — Q
+from the decoder stream, K/V from the encoder stream (fused KV GEMM),
+same fusion menu as the self-attention variants
+(``csrc/multihead_attn/encdec_multihead_attn_*.cu``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+
+
+class EncdecMultiheadAttn(nn.Module):
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    use_bias: bool = False
+    include_norm_add: bool = False
+    impl: str = "fast"
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, query, key, value=None, key_padding_mask=None,
+                 attn_mask=None, is_training: bool = True,
+                 deterministic: Optional[bool] = None):
+        deterministic = (not is_training) if deterministic is None else deterministic
+        e, h = self.embed_dim, self.num_heads
+        d = e // h
+        sq, b, _ = query.shape
+        sk = key.shape[0]
+        residual = query
+        x = query
+
+        if self.include_norm_add:
+            lnw = self.param("lyr_nrm_gamma_weights", nn.initializers.ones, (e,), self.param_dtype)
+            lnb = self.param("lyr_nrm_beta_weights", nn.initializers.zeros, (e,), self.param_dtype)
+            x = fused_layer_norm_affine(x, lnw.astype(x.dtype), lnb.astype(x.dtype), (e,))
+
+        wq = self.param("q_weight", nn.initializers.lecun_normal(), (e, e), self.param_dtype)
+        wkv = self.param("kv_weight", nn.initializers.lecun_normal(), (2 * e, e), self.param_dtype)
+        q = x @ wq.T.astype(x.dtype)
+        kv = key @ wkv.T.astype(key.dtype)
+        k, v = jnp.split(kv, 2, axis=-1)
+
+        qh = q.reshape(sq, b, h, d).transpose(1, 2, 0, 3)
+        kh = k.reshape(sk, b, h, d).transpose(1, 2, 0, 3)
+        vh = v.reshape(sk, b, h, d).transpose(1, 2, 0, 3)
+        scale = d ** -0.5
+
+        if self.impl == "fast" and key_padding_mask is None and attn_mask is None:
+            ctx = flash_attention(qh, kh, vh, scale=scale)
+        else:
+            scores = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                                kh.astype(jnp.float32)) * scale
+            if attn_mask is not None:
+                scores = scores + attn_mask.astype(jnp.float32)
+            if key_padding_mask is not None:
+                scores = jnp.where(key_padding_mask[:, None, None, :], -10000.0, scores)
+            probs = jax.nn.softmax(scores, axis=-1)
+            if self.dropout > 0 and not deterministic:
+                probs = nn.Dropout(self.dropout, deterministic=False)(
+                    probs, rng=self.make_rng("dropout"))
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", probs,
+                             vh.astype(jnp.float32)).astype(qh.dtype)
+
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(sq, b, e)
+        wo = self.param("out_proj_weight", nn.initializers.lecun_normal(),
+                        (e, e), self.param_dtype)
+        out = ctx @ wo.T.astype(ctx.dtype)
+        if self.use_bias:
+            ob = self.param("out_proj_bias", nn.initializers.zeros, (e,), self.param_dtype)
+            out = out + ob.astype(out.dtype)
+        if self.dropout > 0 and not deterministic:
+            out = nn.Dropout(self.dropout, deterministic=False)(
+                out, rng=self.make_rng("dropout"))
+        if self.include_norm_add:
+            out = out + residual
+        return out

@@ -1,0 +1,42 @@
+"""Standalone fused mask+softmax+dropout.
+
+Reference: ``apex/contrib/multihead_attn/mask_softmax_dropout_func.py`` +
+``fast_mask_softmax_dropout_cuda`` (setup.py:369-487 variant list): the
+softmax stage of attention as its own fused op, with pad mask and
+probability dropout, keeping the dropout mask for exact backward.
+
+TPU: one jit region; dropout uses an explicit key; backward follows from
+the ops' custom VJPs (dropout mask reconstructed from the same key —
+no mask storage, same math).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.softmax import scaled_masked_softmax
+
+
+def fast_mask_softmax_dropout(inputs, pad_mask=None, *, is_training=True,
+                              dropout_prob=0.0, key=None, scale=1.0):
+    probs = scaled_masked_softmax(inputs, pad_mask, scale)
+    if is_training and dropout_prob > 0.0:
+        if key is None:
+            raise ValueError("dropout requires a PRNG key")
+        keep = jax.random.bernoulli(key, 1.0 - dropout_prob, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_prob), 0.0).astype(probs.dtype)
+    return probs
+
+
+class MaskSoftmaxDropout:
+    """Module-style wrapper mirroring the reference class API."""
+
+    def __init__(self, dropout: float = 0.0, scale: float = 1.0):
+        self.dropout = dropout
+        self.scale = scale
+
+    def __call__(self, inputs, pad_mask=None, is_training=True, key=None):
+        return fast_mask_softmax_dropout(
+            inputs, pad_mask, is_training=is_training,
+            dropout_prob=self.dropout, key=key, scale=self.scale)

@@ -1,0 +1,123 @@
+"""Self multihead attention with optional fused pre-LN residual add.
+
+Reference: ``apex/contrib/multihead_attn/self_multihead_attn.py:26`` —
+``SelfMultiheadAttn(embed_dim, num_heads, dropout, bias,
+include_norm_add, separate_qkv_params, impl='fast'|'default')``; the
+'fast' impl is the fully fused CUDA path (QKV GEMM + strided-batch GEMMs
++ softmax + dropout + out-proj, optionally pre-LN + residual,
+``csrc/multihead_attn/self_multihead_attn_*.cu``), 'default' composes
+torch ops.
+
+TPU: 'fast' routes scores through the Pallas flash-attention kernel;
+'default' uses the unfused reference composition (useful for numerics
+checks, like the reference's impl switch). ``include_norm_add`` fuses
+layernorm before QKV and adds the residual after the projection
+(the ``norm_add`` CUDA variants). Probability dropout is applied in the
+'default' path exactly as the reference; the 'fast' path applies it to
+the attention output (documented delta — in-kernel PRNG dropout lands
+with the Pallas dropout epilogue).
+
+Layout: inputs are [seq, batch, embed] like the reference modules.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.flash_attention import flash_attention, mha_reference
+from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+
+
+class SelfMultiheadAttn(nn.Module):
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    use_bias: bool = False
+    include_norm_add: bool = False
+    separate_qkv_params: bool = False
+    impl: str = "fast"
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, query, key=None, value=None, key_padding_mask=None,
+                 attn_mask=None, is_training: bool = True,
+                 deterministic: Optional[bool] = None):
+        if self.embed_dim % self.num_heads:
+            raise ValueError("embed_dim must be divisible by num_heads")
+        deterministic = (not is_training) if deterministic is None else deterministic
+        e = self.embed_dim
+        h = self.num_heads
+        d = e // h
+        s, b, _ = query.shape
+        residual = query
+        x = query
+
+        if self.include_norm_add:
+            lnw = self.param("lyr_nrm_gamma_weights", nn.initializers.ones, (e,), self.param_dtype)
+            lnb = self.param("lyr_nrm_beta_weights", nn.initializers.zeros, (e,), self.param_dtype)
+            x = fused_layer_norm_affine(x, lnw.astype(x.dtype), lnb.astype(x.dtype), (e,))
+
+        if self.separate_qkv_params:
+            wq = self.param("q_weight", nn.initializers.lecun_normal(), (e, e), self.param_dtype)
+            wk = self.param("k_weight", nn.initializers.lecun_normal(), (e, e), self.param_dtype)
+            wv = self.param("v_weight", nn.initializers.lecun_normal(), (e, e), self.param_dtype)
+            q = x @ wq.T.astype(x.dtype)
+            k = x @ wk.T.astype(x.dtype)
+            v = x @ wv.T.astype(x.dtype)
+        else:
+            w = self.param("qkv_weight", nn.initializers.lecun_normal(), (3 * e, e), self.param_dtype)
+            qkv = x @ w.T.astype(x.dtype)
+            if self.use_bias:
+                qb = self.param("qkv_bias", nn.initializers.zeros, (3 * e,), self.param_dtype)
+                qkv = qkv + qb.astype(qkv.dtype)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        # [s, b, e] -> [b, h, s, d]
+        def to_bhsd(t):
+            return t.reshape(s, b, h, d).transpose(1, 2, 0, 3)
+
+        qh, kh, vh = to_bhsd(q), to_bhsd(k), to_bhsd(v)
+        scale = d ** -0.5
+
+        causal = attn_mask == "causal"
+        if self.impl == "fast" and key_padding_mask is None and (
+                attn_mask is None or causal):
+            ctx = flash_attention(qh, kh, vh, causal=bool(causal), scale=scale)
+            if self.dropout > 0 and not deterministic:
+                ctx = nn.Dropout(self.dropout, deterministic=False)(
+                    ctx, rng=self.make_rng("dropout"))
+        else:
+            scores = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
+                                kh.astype(jnp.float32)) * scale
+            if causal:
+                cm = jnp.arange(s)[None, :] > jnp.arange(s)[:, None]
+                scores = jnp.where(cm, -10000.0, scores)
+            elif attn_mask is not None:
+                scores = scores + attn_mask.astype(jnp.float32)  # additive mask
+            if key_padding_mask is not None:
+                # [b, sk] True = pad
+                scores = jnp.where(key_padding_mask[:, None, None, :], -10000.0, scores)
+            probs = jax.nn.softmax(scores, axis=-1)
+            if self.dropout > 0 and not deterministic:
+                probs = nn.Dropout(self.dropout, deterministic=False)(
+                    probs, rng=self.make_rng("dropout"))
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", probs,
+                             vh.astype(jnp.float32)).astype(qh.dtype)
+
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, e)
+        wo = self.param("out_proj_weight", nn.initializers.lecun_normal(),
+                        (e, e), self.param_dtype)
+        out = ctx @ wo.T.astype(ctx.dtype)
+        if self.use_bias:
+            ob = self.param("out_proj_bias", nn.initializers.zeros, (e,), self.param_dtype)
+            out = out + ob.astype(out.dtype)
+        if self.dropout > 0 and not deterministic:
+            out = nn.Dropout(self.dropout, deterministic=False)(
+                out, rng=self.make_rng("dropout"))
+        if self.include_norm_add:
+            out = out + residual
+        return out

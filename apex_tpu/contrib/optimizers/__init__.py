@@ -1,0 +1,9 @@
+"""apex_tpu.contrib.optimizers — ZeRO-sharded optimizers + legacy wrappers.
+
+Reference: ``apex/contrib/optimizers/`` (DistributedFusedAdam,
+DistributedFusedLAMB, FP16_Optimizer, deprecated FusedAdam/FusedSGD).
+"""
+
+from apex_tpu.contrib.optimizers.distributed_fused_adam import DistributedFusedAdam  # noqa: F401
+from apex_tpu.contrib.optimizers.distributed_fused_lamb import DistributedFusedLAMB  # noqa: F401
+from apex_tpu.contrib.optimizers.fp16_optimizer import FP16_Optimizer  # noqa: F401

@@ -1,0 +1,62 @@
+"""Legacy FP16_Optimizer wrapper (contrib flavor).
+
+Reference: ``apex/contrib/optimizers/fp16_optimizer.py`` — wraps a fused
+optimizer with fp32 master weights and (dynamic) loss scaling for users
+not on the amp frontend; exposes ``state_dict``/``load_state_dict``
+(:179-230).
+
+TPU: thin composition of an apex_tpu fused optimizer (which already does
+master weights) with a ``LossScaler``; step() unscales, skip-on-overflow,
+and updates the scale.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import scaler as scaler_mod
+from apex_tpu.amp.scaler import LossScaler
+
+
+class FP16_Optimizer:
+    def __init__(self, init_optimizer, static_loss_scale=1.0,
+                 dynamic_loss_scale=False, dynamic_loss_args=None,
+                 verbose=False):
+        self.optimizer = init_optimizer
+        self.optimizer.master_weights = True
+        args = dynamic_loss_args or {}
+        self.loss_scaler = (LossScaler("dynamic", **args) if dynamic_loss_scale
+                            else LossScaler(static_loss_scale))
+        self.verbose = verbose
+
+    @property
+    def loss_scale(self):
+        return self.loss_scaler.loss_scale()
+
+    def scale_loss(self, loss):
+        return scaler_mod.scale_value(jnp.asarray(loss), self.loss_scaler.state)
+
+    def backward(self, loss):  # API-parity: user computes grads explicitly in JAX
+        raise NotImplementedError(
+            "JAX has no .backward(); compute grads of self.scale_loss(loss) "
+            "and call step(grads)")
+
+    def step(self, grads=None, closure=None):
+        if self.optimizer.state is None:
+            self.optimizer.initialize_state()
+        self.optimizer.arm_scaler(self.loss_scaler)
+        return self.optimizer.step(grads)
+
+    def zero_grad(self, set_grads_to_None=True):
+        pass
+
+    def state_dict(self) -> dict:
+        return {
+            "loss_scaler": self.loss_scaler.state_dict(),
+            "optimizer_state_dict": self.optimizer.state_dict(),
+        }
+
+    def load_state_dict(self, sd: dict):
+        self.loss_scaler.load_state_dict(sd["loss_scaler"])
+        self.optimizer.load_state_dict(sd["optimizer_state_dict"])

@@ -1,0 +1,233 @@
+"""Flash attention: Pallas TPU kernel + chunked-recompute backward.
+
+Reference targets (SURVEY §2.2):
+- ``fmhalib`` (``apex/contrib/csrc/fmha/fmha_api.cpp``): fused MHA for
+  packed variable-length sequences (cu_seqlens), seqlen ≤ 512, sm80 only;
+- ``fast_multihead_attn`` (``apex/contrib/csrc/multihead_attn/*``): fused
+  QKV GEMM + batched score GEMM + softmax + dropout + out-projection.
+
+TPU design: one flash-attention kernel with online softmax covers both —
+no seqlen cap, with **segment ids** replacing cu_seqlens for packed varlen
+batches (equal-length padding-free packing, the TPU-friendly layout) and
+causal masking for decoder use. The forward is a Pallas kernel tiled for
+the MXU (q blocks resident in VMEM, k/v streamed through the innermost
+grid dimension with online (m, l, acc) accumulation in VMEM scratch);
+the backward recomputes attention blockwise (flash-style O(s) memory)
+with plain XLA ops — dq/dk/dv each from one scan over blocks.
+
+Shapes: q [b, h, sq, d]; k, v [b, h, sk, d]; segment_ids int32 [b, sq]
+([b, sk] for kv if lengths differ). fp32 accumulation throughout.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Reference (unfused) implementation — the parity baseline, and the O(s^2)
+# fallback for tiny shapes.
+# ---------------------------------------------------------------------------
+
+def mha_reference(q, k, v, *, causal=False, segment_ids_q=None,
+                  segment_ids_kv=None, scale=None, bias=None):
+    d = q.shape[-1]
+    scale = d ** -0.5 if scale is None else scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    sq, sk = s.shape[-2], s.shape[-1]
+    if causal:
+        cm = jnp.arange(sk)[None, :] > jnp.arange(sq)[:, None] + (sk - sq)
+        s = jnp.where(cm, _NEG_INF, s)
+    if segment_ids_q is not None:
+        sid_kv = segment_ids_q if segment_ids_kv is None else segment_ids_kv
+        seg = segment_ids_q[:, None, :, None] == sid_kv[:, None, None, :]
+        s = jnp.where(seg, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(sq_ref, skv_ref, q_ref, k_ref, v_ref,  # inputs
+                o_ref, lse_ref,                        # outputs
+                m_scr, l_scr, acc_scr,                 # scratch
+                *, scale, causal, block_q, block_k, use_segments, kv_len):
+    kb = pl.program_id(3)
+    n_kb = pl.num_programs(3)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0, 0].astype(jnp.float32)              # [block_q, d]
+    k = k_ref[0, 0].astype(jnp.float32)              # [block_k, d]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+
+    qi = pl.program_id(2)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    mask = jnp.ones((block_q, block_k), jnp.bool_)
+    if causal:
+        # offset aligns the ends for cross-length causal
+        mask &= k_pos <= q_pos + (kv_len - pl.num_programs(2) * block_q)
+    if use_segments:
+        sid_q = sq_ref[0]                             # [block_q]
+        sid_k = skv_ref[0]                            # [block_k]
+        mask &= sid_q[:, None] == sid_k[None, :]
+    s = jnp.where(mask, s, _NEG_INF)
+
+    m_prev = m_scr[:]                                 # [block_q, 1]
+    m_cur = jnp.max(s, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    # guard fully-masked rows (padding): keep exp at 0
+    p = jnp.exp(s - m_new)
+    p = jnp.where(mask, p, 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = m_new
+    l_scr[:] = l_new
+
+    @pl.when(kb == n_kb - 1)
+    def _finish():
+        l = l_scr[:]
+        safe_l = jnp.where(l > 0, l, 1.0)
+        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scr[:] + jnp.log(safe_l))[:, 0]
+
+
+def _flash_fwd(q, k, v, segment_ids_q, segment_ids_kv, scale, causal,
+               block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(f"seq lens ({sq},{sk}) must be divisible by blocks "
+                         f"({block_q},{block_k})")
+    use_segments = segment_ids_q is not None
+    if not use_segments:
+        segment_ids_q = jnp.zeros((b, sq), jnp.int32)
+        segment_ids_kv = jnp.zeros((b, sk), jnp.int32)
+    elif segment_ids_kv is None:
+        segment_ids_kv = segment_ids_q
+
+    grid = (b, h, sq // block_q, sk // block_k)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, use_segments=use_segments, kv_len=sk)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q), lambda b_, h_, qi, ki: (b_, qi)),
+            pl.BlockSpec((1, block_k), lambda b_, h_, qi, ki: (b_, ki)),
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b_, h_, qi, ki: (b_, h_, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(segment_ids_q, segment_ids_kv, q.reshape(b, h, sq, d),
+      k.reshape(b, h, sk, d), v.reshape(b, h, sk, d))
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward: blockwise recompute with XLA (flash-style memory, O(s^2) flops)
+# ---------------------------------------------------------------------------
+
+def _bwd_math(res, do, *, scale, causal):
+    q, k, v, out, lse, sid_q, sid_kv = res
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    sq, sk = s.shape[-2], s.shape[-1]
+    mask = jnp.ones(s.shape[-2:], jnp.bool_)
+    if causal:
+        mask &= ~(jnp.arange(sk)[None, :] > jnp.arange(sq)[:, None] + (sk - sq))
+    s = jnp.where(mask, s, _NEG_INF)
+    if sid_q is not None:
+        seg = sid_q[:, None, :, None] == sid_kv[:, None, None, :]
+        s = jnp.where(seg, s, _NEG_INF)
+    p = jnp.exp(s - lse[..., None])                      # exact softmax via saved lse
+    do32 = do.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v.astype(jnp.float32))
+    delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1, keepdims=True)
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32))
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def flash_attention(q, k, v, segment_ids_q=None, segment_ids_kv=None,
+                    causal: bool = False, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused attention. Returns [b, h, sq, d].
+
+    ``segment_ids_*``: packed-varlen support (FMHA cu_seqlens analog) —
+    tokens attend only within equal segment ids; id -1 rows are padding
+    (they attend nothing and produce zeros).
+    """
+    out, _ = _fa_fwd(q, k, v, segment_ids_q, segment_ids_kv, causal, scale,
+                     block_q, block_k, interpret)
+    return out
+
+
+def _resolve_interpret(interpret):
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+def _fa_fwd(q, k, v, sid_q, sid_kv, causal, scale, block_q, block_k, interpret):
+    scale_v = q.shape[-1] ** -0.5 if scale is None else scale
+    out, lse = _flash_fwd(q, k, v, sid_q, sid_kv, scale_v, causal,
+                          block_q, block_k, _resolve_interpret(interpret))
+    return out, (q, k, v, out, lse, sid_q, sid_kv)
+
+
+def _fa_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    scale_v = res[0].shape[-1] ** -0.5 if scale is None else scale
+    dq, dk, dv = _bwd_math(res, do, scale=scale_v, causal=causal)
+    return dq, dk, dv, None, None
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

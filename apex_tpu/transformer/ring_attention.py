@@ -1,0 +1,114 @@
+"""Ring attention: context-parallel exact attention for long sequences.
+
+This is a first-class NEW capability (SURVEY §5 flags long-context as
+absent from the reference — no ring attention, context parallel, or
+Ulysses; its levers stop at 2048-token fused softmax and ≤512-token
+FMHA). TPU design per the ring-attention pattern: the sequence is sharded
+over the ``context`` mesh axis; each device holds local Q/K/V chunks,
+K/V rotate around the ring via ``ppermute`` (ICI neighbor transfers),
+and each device folds every visiting block into its local queries'
+online-softmax state — exact attention over the full sequence with
+O(seq/cp) memory per chip and compute overlapped with the ring transfer
+by XLA's async collectives.
+
+Causality is handled by global-position masking: block pairs strictly in
+the future are skipped numerically (their contribution underflows via the
+-inf max), so the math matches single-device causal attention exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer import parallel_state as ps
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q32, k32, v32, scale, mask):
+    """One (q-block, kv-block) pair: returns (m, l, acc) partials."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * scale
+    s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)                                  # [b,h,q]
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p, v32)
+    return m, l, acc
+
+
+def ring_self_attention(q, k, v, axis_name: str = ps.CONTEXT_AXIS,
+                        causal: bool = False, scale: Optional[float] = None):
+    """Exact attention with sequence sharded over ``axis_name``.
+
+    q, k, v: [b, h, s_local, d] — the local sequence chunk (global
+    sequence = cp * s_local, chunks in rank order). Runs inside shard_map.
+    Returns the local chunk of the attention output.
+    """
+    cp = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    scale = d ** -0.5 if scale is None else scale
+    q32 = q.astype(jnp.float32)
+    q_pos = rank * s_local + jnp.arange(s_local)
+
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def body(t, carry):
+        k_cur, v_cur, m, l, acc = carry
+        src = jnp.mod(rank - t, cp)
+        k_pos = src * s_local + jnp.arange(s_local)
+        if causal:
+            mask = k_pos[None, :] <= q_pos[:, None]
+        else:
+            mask = jnp.ones((s_local, s_local), jnp.bool_)
+        bm, bl, bacc = _block_attn(q32, k_cur.astype(jnp.float32),
+                                   v_cur.astype(jnp.float32), scale,
+                                   mask[None, None])
+        m_new = jnp.maximum(m, bm)
+        # guard: exp(-inf - -inf) on never-touched rows
+        a_old = jnp.where(m > _NEG_INF / 2, jnp.exp(m - m_new), 0.0)
+        a_blk = jnp.where(bm > _NEG_INF / 2, jnp.exp(bm - m_new), 0.0)
+        l_new = a_old * l + a_blk * bl
+        acc_new = a_old[..., None] * acc + a_blk[..., None] * bacc
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, acc_new)
+
+    init = (k, v,
+            jnp.full((b, h, s_local), _NEG_INF, jnp.float32),
+            jnp.zeros((b, h, s_local), jnp.float32),
+            jnp.zeros((b, h, s_local, d), jnp.float32))
+    _, _, m, l, acc = jax.lax.fori_loop(0, cp, body, init)
+    safe_l = jnp.where(l > 0, l, 1.0)
+    return (acc / safe_l[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str = ps.CONTEXT_AXIS,
+                      causal: bool = False, scale: Optional[float] = None):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses pattern):
+    re-shard [b, h, s/cp, d] → [b, h/cp, s, d] with one all_to_all, run
+    full-sequence flash attention on the local heads, shard back.
+
+    Complements ring attention: better when heads ≥ cp and the full
+    sequence fits one chip's memory; the all_to_all rides ICI.
+    """
+    cp = jax.lax.axis_size(axis_name)
+    b, h, s_local, d = q.shape
+    if h % cp:
+        raise ValueError(f"num heads {h} must be divisible by cp {cp}")
+
+    def to_seq(t):   # [b, h, s/cp, d] -> [b, h/cp, s, d]
+        return jax.lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    def to_heads(t):  # inverse
+        return jax.lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    from apex_tpu.ops.flash_attention import flash_attention
+    qs, ks, vs = to_seq(q), to_seq(k), to_seq(v)
+    out = flash_attention(qs, ks, vs, causal=causal, scale=scale,
+                          block_q=min(128, qs.shape[2]), block_k=min(128, ks.shape[2]))
+    return to_heads(out)

@@ -1,0 +1,89 @@
+"""Flash attention kernel parity tests (Pallas interpret mode on CPU).
+
+Mirrors ``apex/contrib/test/fmha/test_fmha.py`` and
+``apex/contrib/test/multihead_attn/*``: the fused kernel must match the
+unfused reference for values and gradients, including causal masking and
+packed-varlen (segment id) batches.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.flash_attention import flash_attention, mha_reference
+
+
+def _qkv(b=2, h=3, sq=64, sk=64, d=16, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(b, h, sq, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, sk, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, sk, d), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference(causal):
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_flash_multiblock_online_softmax():
+    """Many k blocks exercise the running (m, l, acc) rescaling."""
+    q, k, v = _qkv(b=1, h=2, sq=32, sk=128, d=8, seed=1)
+    out = flash_attention(q, k, v, block_q=16, block_k=16)
+    ref = mha_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_flash_gradients():
+    q, k, v = _qkv(b=1, h=2, sq=32, sk=32, d=8, seed=2)
+
+    def f_fused(q, k, v):
+        return jnp.sum(jnp.tanh(flash_attention(q, k, v, causal=True,
+                                                block_q=16, block_k=16)))
+
+    def f_ref(q, k, v):
+        return jnp.sum(jnp.tanh(mha_reference(q, k, v, causal=True)))
+
+    g1 = jax.grad(f_fused, (0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, (0, 1, 2))(q, k, v)
+    for a, r in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r), rtol=1e-3, atol=1e-4)
+
+
+def test_flash_segment_ids_varlen():
+    """Packed batch: two sequences per row must not attend across the
+    boundary (FMHA cu_seqlens parity)."""
+    b, h, s, d = 1, 2, 32, 8
+    q, k, v = _qkv(b, h, s, s, d, seed=3)
+    sid = jnp.asarray(np.repeat([[0] * 12 + [1] * 20], b, 0))
+    out = flash_attention(q, k, v, segment_ids_q=sid, block_q=16, block_k=16)
+    ref = mha_reference(q, k, v, segment_ids_q=sid)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    # cross-check isolation directly: perturbing segment 1's v must not
+    # change segment 0's outputs
+    v2 = v.at[:, :, 20:].add(10.0)
+    out2 = flash_attention(q, k, v2, segment_ids_q=sid, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(out[:, :, :12]), np.asarray(out2[:, :, :12]),
+                               rtol=1e-5, atol=1e-6)
+    assert not np.allclose(np.asarray(out[:, :, 12:]), np.asarray(out2[:, :, 12:]))
+
+
+def test_flash_bf16():
+    q, k, v = _qkv(d=8)
+    out = flash_attention(q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+                          v.astype(jnp.bfloat16), block_q=32, block_k=32)
+    assert out.dtype == jnp.bfloat16
+    ref = mha_reference(q, k, v)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_flash_rejects_indivisible():
+    q, k, v = _qkv(sq=33, sk=33)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, block_q=32, block_k=32)
