@@ -38,3 +38,11 @@ from apex_tpu import normalization  # noqa: F401
 from apex_tpu import parallel  # noqa: F401
 from apex_tpu import fused_dense  # noqa: F401
 from apex_tpu import mlp  # noqa: F401
+from apex_tpu import fp16_utils  # noqa: F401
+from apex_tpu import reparameterization  # noqa: F401
+from apex_tpu import rnn  # noqa: F401
+from apex_tpu import pyprof  # noqa: F401
+
+# heavier subpackages (transformer, contrib, models) import on demand:
+#   import apex_tpu.transformer / apex_tpu.contrib / apex_tpu.models
+RNN = rnn  # reference package name alias (apex.RNN)

@@ -7,3 +7,10 @@ kernel-by-kernel mapping.
 """
 
 from apex_tpu.contrib import xentropy  # noqa: F401
+from apex_tpu.contrib import multihead_attn  # noqa: F401
+from apex_tpu.contrib import fmha  # noqa: F401
+from apex_tpu.contrib import optimizers  # noqa: F401
+from apex_tpu.contrib import transducer  # noqa: F401
+from apex_tpu.contrib import groupbn  # noqa: F401
+from apex_tpu.contrib import sparsity  # noqa: F401
+from apex_tpu.contrib import bottleneck  # noqa: F401

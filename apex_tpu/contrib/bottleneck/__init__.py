@@ -1,0 +1,13 @@
+"""apex_tpu.contrib.bottleneck — fused bottleneck + spatial parallelism.
+
+Reference: ``apex/contrib/bottleneck/bottleneck.py:52-512`` — a
+cudnn-frontend-fused ResNet bottleneck and ``SpatialBottleneck``, which
+splits the H dimension across ``spatial_group_size`` GPUs with explicit
+halo transfers around each 3x3 conv.
+"""
+
+from apex_tpu.contrib.bottleneck.bottleneck import (  # noqa: F401
+    Bottleneck,
+    SpatialBottleneck,
+    halo_exchange,
+)

@@ -1,0 +1,88 @@
+"""Bottleneck block + spatial (H-dim) parallelism with halo exchange.
+
+Reference: ``apex/contrib/bottleneck/bottleneck.py`` —
+``Bottleneck`` (:52) is the conv1x1-conv3x3-conv1x1 residual block fused
+through the cudnn-frontend v8 engine; ``SpatialBottleneck`` (:218-512)
+shards the H dimension over ``spatial_group_size`` GPUs, hand-managing
+NCCL halo pushes around every 3x3 conv.
+
+TPU: block fusion is XLA's job — ``Bottleneck`` is the plain graph (see
+``apex_tpu.models.resnet.Bottleneck``). Spatial parallelism maps to an H-
+sharded ``shard_map`` where :func:`halo_exchange` swaps 1-row halos with
+ring neighbors via two ``ppermute``s before each 3x3 conv — the explicit
+form of what GSPMD inserts automatically when you simply shard H in a
+sharding constraint (both are supported; the explicit module exists for
+parity and for fine control).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.resnet import Bottleneck  # the fused-block graph
+
+
+def halo_exchange(x, axis_name: str, halo: int = 1):
+    """Exchange ``halo`` rows (H axis = dim 1 of NHWC) with ring neighbors.
+
+    Returns x padded to [N, H_local + 2*halo, W, C]; the first/last rank
+    get zero halos (edge padding), matching the reference's halo handling
+    at the volume boundary (``bottleneck.py:218+``).
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    top = x[:, :halo]        # rows to send upward (to rank-1)
+    bot = x[:, -halo:]       # rows to send downward (to rank+1)
+    # receive bottom neighbor's top rows as our lower halo, and vice versa
+    from_next = jax.lax.ppermute(top, axis_name, [(i, (i - 1) % n) for i in range(n)])
+    from_prev = jax.lax.ppermute(bot, axis_name, [(i, (i + 1) % n) for i in range(n)])
+    zero = jnp.zeros_like(top)
+    upper = jnp.where(idx == 0, zero, from_prev)
+    lower = jnp.where(idx == n - 1, zero, from_next)
+    return jnp.concatenate([upper, x, lower], axis=1)
+
+
+class SpatialBottleneck(nn.Module):
+    """Bottleneck whose 3x3 conv runs on H-sharded activations.
+
+    Run inside ``shard_map`` with inputs sharded [N, H/spatial, W, C] over
+    ``axis_name``. Only stride-1 blocks support spatial sharding (the
+    reference's spatial path has the same constraint for the halo math).
+    """
+
+    filters: int
+    strides: int = 1
+    expansion: int = 4
+    axis_name: str = "data"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if self.strides != 1:
+            raise ValueError("SpatialBottleneck supports stride 1 (reference parity)")
+        from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+        conv = lambda f, k, name, **kw: nn.Conv(
+            f, k, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
+            name=name, **kw)
+        # BN stats synced over the spatial axis so sharded == full-volume
+        # (the reference's spatial path shares BN stats via its bn_group)
+        norm = lambda f, name: SyncBatchNorm(num_features=f,
+                                             axis_name=self.axis_name, name=name)
+        ura = not train
+        residual = x
+        y = conv(self.filters, (1, 1), "conv1")(x)
+        y = jax.nn.relu(norm(self.filters, "n1")(y, use_running_average=ura))
+        # 3x3 with halo: pad H with neighbor rows, conv VALID on H
+        y = halo_exchange(y, self.axis_name, 1)
+        y = conv(self.filters, (3, 3), "conv2",
+                 padding=[(0, 0), (1, 1)])(y)
+        y = jax.nn.relu(norm(self.filters, "n2")(y, use_running_average=ura))
+        y = conv(self.filters * self.expansion, (1, 1), "conv3")(y)
+        y = norm(self.filters * self.expansion, "n3")(y, use_running_average=ura)
+        if residual.shape[-1] != self.filters * self.expansion:
+            residual = conv(self.filters * self.expansion, (1, 1), "proj")(x)
+        return jax.nn.relu(y + residual)

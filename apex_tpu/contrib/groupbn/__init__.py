@@ -1,0 +1,12 @@
+"""apex_tpu.contrib.groupbn — NHWC BatchNorm with cross-device BN groups.
+
+Reference: ``apex/contrib/groupbn/batch_norm.py`` (``BatchNorm2d_NHWC``)
+over ``apex/contrib/csrc/groupbn/*`` (~5.1k LoC: NHWC kernels,
+add+relu fusion, multi-GPU ``bn_group`` via CUDA IPC peer buffers).
+
+TPU: NHWC is the native layout and cross-chip stat exchange is a psum —
+the whole extension reduces to :class:`apex_tpu.parallel.SyncBatchNorm`
+configured with a group; this module provides the reference's class API.
+"""
+
+from apex_tpu.contrib.groupbn.batch_norm import BatchNorm2d_NHWC  # noqa: F401

@@ -1,0 +1,44 @@
+"""BatchNorm2d_NHWC: group BN with fused add+relu.
+
+Reference API (``apex/contrib/groupbn/batch_norm.py``): constructor takes
+``(planes, fuse_relu=False, bn_group=1)``; forward takes ``(x, z=None)``
+where ``z`` is a residual fused into the normalize+add+relu kernel
+(``bn_add_relu``). ``bn_group > 1`` syncs stats across that many devices.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm, create_syncbn_process_group
+
+
+class BatchNorm2d_NHWC(nn.Module):
+    num_features: int
+    fuse_relu: bool = False
+    bn_group: int = 1
+    world_size: int = 1            # for group construction
+    momentum: float = 0.9          # torch bn momentum convention: 1-m below
+    eps: float = 1e-5
+    axis_name: Optional[str] = "data"
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, z=None, use_running_average: bool = False):
+        groups = None
+        axis = self.axis_name if self.bn_group > 1 else None
+        if self.bn_group > 1 and self.world_size > self.bn_group:
+            groups = create_syncbn_process_group(self.bn_group, self.world_size)
+        bn = SyncBatchNorm(
+            num_features=self.num_features,
+            eps=self.eps,
+            momentum=1.0 - self.momentum,
+            axis_name=axis,
+            axis_index_groups=groups,
+            fuse_relu=self.fuse_relu,
+            param_dtype=self.param_dtype,
+            name="bn")
+        return bn(x, z=z, use_running_average=use_running_average)

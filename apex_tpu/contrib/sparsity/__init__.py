@@ -1,0 +1,10 @@
+"""apex_tpu.contrib.sparsity — ASP (automatic structured sparsity).
+
+Reference: ``apex/contrib/sparsity/asp.py`` + ``sparse_masklib.py``:
+2:4 structured sparsity masks computed from weight magnitudes, applied to
+whitelisted layers and re-applied after each optimizer step so pruned
+weights stay zero through fine-tuning.
+"""
+
+from apex_tpu.contrib.sparsity.asp import ASP  # noqa: F401
+from apex_tpu.contrib.sparsity.sparse_masklib import create_mask, m4n2_1d  # noqa: F401

@@ -1,0 +1,94 @@
+"""ASP: automatic sparsity for fine-tuning.
+
+Reference: ``apex/contrib/sparsity/asp.py`` — ``ASP.init_model_for_pruning``
+whitelists layer types/min sizes, ``compute_sparse_masks`` builds 2:4
+masks, and the optimizer is patched so masks are re-applied after every
+step (pruned weights stay zero). Restore via ``restore_pruned_weights``.
+
+TPU: masks are a pytree of the same structure as params; application is
+``params * masks``; "patching the optimizer" is a functional wrapper
+around ``apply``. State (masks) lives on the ASP object or flows
+explicitly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.sparsity.sparse_masklib import create_mask
+from apex_tpu.utils.tree import tree_map_with_path_names
+
+
+def _default_whitelist(path_names, leaf) -> bool:
+    """Matrix-shaped weights with dims divisible by 4 (the reference
+    whitelists Linear/Conv weights with min features, ``asp.py``)."""
+    if not hasattr(leaf, "ndim") or leaf.ndim < 2:
+        return False
+    name = path_names[-1].lower() if path_names else ""
+    if name in ("bias", "scale"):
+        return False
+    return leaf.shape[-1] % 4 == 0 and leaf.shape[-1] >= 16
+
+
+class ASP:
+    """Class-method API mirroring the reference; also usable as an instance."""
+
+    _masks: Any = None
+    _whitelist: Callable = staticmethod(_default_whitelist)
+    _pattern: str = "2:4"
+
+    @classmethod
+    def init_model_for_pruning(cls, params, mask_calculator: str = "m4n2_1d",
+                               whitelist: Optional[Callable] = None,
+                               allow_recompute_mask: bool = False):
+        if whitelist is not None:
+            cls._whitelist = staticmethod(whitelist)
+        if "4" in mask_calculator:
+            cls._pattern = "2:4"
+        return params
+
+    @classmethod
+    def compute_sparse_masks(cls, params):
+        def one(path, leaf):
+            if cls._whitelist(path, leaf):
+                return create_mask(leaf, cls._pattern)
+            return jnp.ones_like(leaf, dtype=bool)
+
+        cls._masks = tree_map_with_path_names(one, params)
+        return cls._masks
+
+    @classmethod
+    def apply_masks(cls, params, masks=None):
+        masks = masks if masks is not None else cls._masks
+        if masks is None:
+            raise RuntimeError("compute_sparse_masks first")
+        return jax.tree.map(lambda p, m: p * m.astype(p.dtype), params, masks)
+
+    @classmethod
+    def init_optimizer_for_pruning(cls, optimizer):
+        """Wrap ``optimizer.apply`` so masks re-apply after every step
+        (the reference patches ``optimizer.step``, ``asp.py``)."""
+        inner_apply = optimizer.apply
+
+        def masked_apply(state, params, grads, skip=None, **kw):
+            new_params, new_state = inner_apply(state, params, grads, skip=skip, **kw)
+            if cls._masks is not None:
+                new_params = cls.apply_masks(new_params)
+            return new_params, new_state
+
+        optimizer.apply = masked_apply
+        return optimizer
+
+    @classmethod
+    def restore_pruned_weights(cls, params):
+        """Masks off — nothing to restore in the functional design (the
+        dense weights were never mutated in place); returns params."""
+        cls._masks = None
+        return params
+
+    @classmethod
+    def is_sparsity_enabled(cls) -> bool:
+        return cls._masks is not None

@@ -1,0 +1,31 @@
+"""Structured-sparsity mask construction.
+
+Reference: ``apex/contrib/sparsity/sparse_masklib.py`` — builds n:m masks
+(default 2:4 along the input dimension) by magnitude, via enumerated
+permutation patterns. TPU: a top-k over contiguous groups of m — one
+vectorized op, jit-safe.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def m4n2_1d(w, *_args, **_kw):
+    """2:4 mask along the last dim (keep the 2 largest |w| of each 4)."""
+    return create_mask(w, pattern="2:4")
+
+
+def create_mask(w, pattern: str = "2:4"):
+    n, m = (int(s) for s in pattern.split(":"))
+    *lead, last = w.shape
+    if last % m:
+        raise ValueError(f"last dim {last} not divisible by group size {m}")
+    g = w.reshape(*lead, last // m, m)
+    mag = jnp.abs(g.astype(jnp.float32))
+    # rank within each group; keep the n largest magnitudes
+    order = jnp.argsort(mag, axis=-1)            # ascending
+    ranks = jnp.argsort(order, axis=-1)          # rank of each element
+    mask = ranks >= (m - n)
+    return mask.reshape(w.shape)

@@ -1,0 +1,119 @@
+"""RNN-T joint + loss.
+
+Reference semantics:
+- ``TransducerJoint`` (``apex/contrib/transducer/transducer.py:5``):
+  joint[b,t,u,:] = f[b,t,:] + g[b,u,:], with optional fused relu+dropout
+  and packed output that drops per-sample (T,U) padding
+  (``transducer_joint_kernel.cu`` packing path).
+- ``TransducerLoss`` (``:68``): RNN-T alpha/beta dynamic program over the
+  (T,U) lattice on log-probs [B,T,U,V] with per-sample lengths
+  (``transducer_loss_kernel.cu`` wavefront kernels).
+
+TPU design: the joint is a broadcast add XLA fuses with its epilogue; the
+loss runs the alpha recursion as a ``lax.scan`` over anti-diagonal
+wavefronts (the same parallel order as the CUDA kernel's per-diagonal
+waves), with gradients via autodiff of the scan (mathematically the beta
+recursion, so no hand-written backward). Packing is unnecessary on TPU —
+masking handles ragged (T,U); the packed API is kept for parity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30
+
+
+def transducer_joint(f, g, f_len=None, g_len=None, *, relu=False,
+                     dropout_prob=0.0, key=None):
+    """joint[b,t,u,:] = f[b,t,:] + g[b,u,:] (+ relu + dropout)."""
+    out = f[:, :, None, :] + g[:, None, :, :]
+    if relu:
+        out = jax.nn.relu(out)
+    if dropout_prob > 0.0:
+        if key is None:
+            raise ValueError("dropout requires a PRNG key")
+        keep = jax.random.bernoulli(key, 1.0 - dropout_prob, out.shape)
+        out = jnp.where(keep, out / (1.0 - dropout_prob), 0.0).astype(out.dtype)
+    return out
+
+
+def transducer_loss(log_probs, labels, f_len, y_len, blank_idx: int = 0):
+    """RNN-T negative log likelihood per batch element.
+
+    ``log_probs``: [B, T, U, V] log-softmax outputs of the joint network
+    (U = max label length + 1); ``labels``: [B, U-1] int targets;
+    ``f_len``: [B] encoder lengths; ``y_len``: [B] label lengths.
+    """
+    B, T, U, V = log_probs.shape
+    lp = log_probs.astype(jnp.float32)
+
+    # blank and emit log-probs per lattice cell
+    blank_lp = lp[..., blank_idx]                                  # [B,T,U]
+    pad_labels = jnp.concatenate(
+        [labels, jnp.zeros((B, 1), labels.dtype)], axis=1)[:, :U]  # [B,U]
+    emit_lp = jnp.take_along_axis(
+        lp, pad_labels[:, None, :, None], axis=-1)[..., 0]         # [B,T,U]
+
+    # mask invalid emit transitions (u >= y_len cannot emit)
+    u_idx = jnp.arange(U)[None, :]
+    emit_valid = u_idx < y_len[:, None]                            # [B,U]
+    emit_lp = jnp.where(emit_valid[:, None, :], emit_lp, _NEG)
+
+    # alpha over anti-diagonal wavefronts: cell (t,u) on diagonal t+u
+    alpha0 = jnp.full((B, T, U), _NEG).at[:, 0, 0].set(0.0)
+
+    def wave(alpha, d):
+        from_t = jnp.concatenate(
+            [jnp.full((B, 1, U), _NEG),
+             alpha[:, :-1, :] + blank_lp[:, :-1, :]], axis=1)
+        from_u = jnp.concatenate(
+            [jnp.full((B, T, 1), _NEG),
+             alpha[:, :, :-1] + emit_lp[:, :, :-1]], axis=2)
+        cand = jnp.logaddexp(from_t, from_u)
+        t_idx = jnp.arange(T)[:, None]
+        on_diag = (t_idx + jnp.arange(U)[None, :]) == d
+        return jnp.where(on_diag[None], cand, alpha), None
+
+    alpha, _ = jax.lax.scan(wave, alpha0, jnp.arange(1, T + U - 1))
+
+    # total log prob: alpha at (f_len-1, y_len) + final blank
+    bidx = jnp.arange(B)
+    t_last = f_len - 1
+    u_last = y_len
+    ll = (alpha[bidx, t_last, u_last] + blank_lp[bidx, t_last, u_last])
+    return -ll
+
+
+class TransducerJoint:
+    """Module-style wrapper (``apex/contrib/transducer/transducer.py:5``)."""
+
+    def __init__(self, pack_output=False, relu=False, dropout=False,
+                 dropout_prob=0.0, probe_mask=False):
+        if pack_output:
+            # packing exists to skip padded compute on CUDA; on TPU static
+            # shapes + masking win — keep the flag but compute unpacked.
+            pass
+        self.relu = relu
+        self.dropout = dropout
+        self.dropout_prob = dropout_prob
+
+    def __call__(self, f, g, f_len=None, g_len=None, batch_offset=None,
+                 packed_batch=0, key=None):
+        return transducer_joint(
+            f, g, f_len, g_len, relu=self.relu,
+            dropout_prob=self.dropout_prob if self.dropout else 0.0, key=key)
+
+
+class TransducerLoss:
+    """Module-style wrapper (``apex/contrib/transducer/transducer.py:68``)."""
+
+    def __init__(self, fuse_softmax_backward=True, opt=1, packed_input=False):
+        del fuse_softmax_backward, opt, packed_input  # fused by construction
+
+    def __call__(self, x, label, f_len, y_len, blank_idx=0, batch_offset=None,
+                 max_f_len=None, debug_list=None):
+        return transducer_loss(x, label, f_len, y_len, blank_idx)

@@ -1,0 +1,17 @@
+"""apex_tpu.fp16_utils — legacy manual mixed-precision utilities.
+
+Reference: ``apex/fp16_utils/__init__.py`` (FP16_Optimizer, loss scalers,
+network conversion helpers). Superseded by ``apex_tpu.amp`` but kept for
+API parity, like the reference keeps them.
+"""
+
+from apex_tpu.fp16_utils.fp16util import (  # noqa: F401
+    network_to_half,
+    convert_network,
+    prep_param_lists,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    to_python_float,
+)
+from apex_tpu.fp16_utils.fp16_optimizer import FP16_Optimizer  # noqa: F401
+from apex_tpu.fp16_utils.loss_scaler import LossScaler, DynamicLossScaler  # noqa: F401

@@ -1,0 +1,75 @@
+"""FP16_Optimizer: manual master-weight mixed precision.
+
+Reference: ``apex/fp16_utils/fp16_optimizer.py:13-270`` — wraps any
+optimizer with fp32 master params, grad unscale, optional
+``clip_master_grads``, and static/dynamic loss scaling, with
+``state_dict``/``load_state_dict`` (:209-270).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.fp16_utils.loss_scaler import DynamicLossScaler, LossScaler
+from apex_tpu.multi_tensor_apply import multi_tensor_l2norm
+
+
+class FP16_Optimizer:
+    def __init__(self, init_optimizer, static_loss_scale=1.0,
+                 dynamic_loss_scale=False, dynamic_loss_args=None,
+                 verbose=False):
+        self.optimizer = init_optimizer
+        self.optimizer.master_weights = True
+        if dynamic_loss_scale:
+            self.loss_scaler = DynamicLossScaler(**(dynamic_loss_args or {}))
+        else:
+            self.loss_scaler = LossScaler(static_loss_scale)
+        self.overflow = False
+        self.first_closure_call_this_step = True
+        self.verbose = verbose
+
+    @property
+    def loss_scale(self):
+        return self.loss_scaler.loss_scale
+
+    def scale_loss(self, loss):
+        return loss * self.loss_scale
+
+    def clip_master_grads(self, max_norm, grads, norm_type=2):
+        """Clip unscaled fp32 grads by global norm
+        (``fp16_optimizer.py:141-164``). Returns (clipped, total_norm)."""
+        leaves = [g.reshape(-1) for g in jax.tree.leaves(grads)]
+        norm, _ = multi_tensor_l2norm(leaves)
+        clip = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+        return jax.tree.map(lambda g: g * clip, grads), norm
+
+    def step(self, grads=None, closure=None):
+        """Unscale grads, check overflow, maybe skip, update scale."""
+        if closure is not None:
+            raise NotImplementedError("closures are not supported on TPU build")
+        if self.optimizer.state is None:
+            self.optimizer.initialize_state()
+        inv = 1.0 / self.loss_scale
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+        self.overflow = self.loss_scaler.has_overflow(grads)
+        if not self.overflow:
+            self.optimizer.step(grads)
+        self.loss_scaler.update_scale(self.overflow)
+        return self.optimizer.params
+
+    def zero_grad(self, set_grads_to_None=False):
+        pass
+
+    def state_dict(self) -> dict:
+        return {
+            "loss_scaler": self.loss_scaler.__dict__.copy(),
+            "dynamic": isinstance(self.loss_scaler, DynamicLossScaler),
+            "overflow": self.overflow,
+            "optimizer_state_dict": self.optimizer.state_dict(),
+        }
+
+    def load_state_dict(self, sd: dict):
+        self.loss_scaler.__dict__.update(sd["loss_scaler"])
+        self.overflow = sd.get("overflow", False)
+        self.optimizer.load_state_dict(sd["optimizer_state_dict"])

@@ -1,0 +1,59 @@
+"""Param-tree conversion helpers.
+
+Reference: ``apex/fp16_utils/fp16util.py`` — ``network_to_half`` /
+``convert_network`` (:44-77, half the model but keep batchnorm fp32),
+``prep_param_lists`` (:78-128, model params + fp32 master copies),
+``master_params_to_model_params`` / ``model_grads_to_master_grads``
+(:130-162).
+
+JAX params are pytrees, so these are pure tree casts; the batchnorm
+exemption uses the same name predicate as amp O2.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.frontend import _is_norm_param
+from apex_tpu.utils.tree import cast_floating
+
+
+def network_to_half(params, half_dtype=jnp.bfloat16):
+    """Cast all floating params to half (``fp16util.py:44-50``)."""
+    return cast_floating(params, half_dtype)
+
+
+def convert_network(params, dtype=jnp.bfloat16):
+    """Cast params to ``dtype``, keeping norm params fp32
+    (``fp16util.py:60-77``)."""
+    return cast_floating(params, dtype, lambda names, x: not _is_norm_param(names))
+
+
+def prep_param_lists(params, flat_master: bool = False):
+    """Return (model_params, master_params) where master is an fp32 copy
+    (``fp16util.py:78-128``); ``flat_master`` returns one flat fp32 vector
+    like the reference's flattened option."""
+    master = cast_floating(params, jnp.float32)
+    if flat_master:
+        from apex_tpu.utils.flat import FlatBuffer
+        spec = FlatBuffer.from_tree(master)
+        return params, spec.pack(master, dtype=jnp.float32)
+    return params, master
+
+
+def master_params_to_model_params(model_params, master_params):
+    """Downcast master values into the model param dtypes
+    (``fp16util.py:130-144``)."""
+    return jax.tree.map(
+        lambda mp, ma: ma.astype(mp.dtype) if jnp.issubdtype(mp.dtype, jnp.floating) else ma,
+        model_params, master_params)
+
+
+def model_grads_to_master_grads(model_grads):
+    """fp16 grads -> fp32 master grads (``fp16util.py:146-162``)."""
+    return cast_floating(model_grads, jnp.float32)
+
+
+def to_python_float(t):
+    return float(t) if hasattr(t, "item") or hasattr(t, "__float__") else t

@@ -1,0 +1,60 @@
+"""Legacy loss-scaler classes.
+
+Reference: ``apex/fp16_utils/loss_scaler.py:10-47`` — ``LossScaler``
+(static) and ``DynamicLossScaler`` with ``has_overflow`` /
+``update_scale`` / ``scale_gradient`` hooks used by FP16_Optimizer.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.tree import tree_all_finite
+
+
+class LossScaler:
+    """Static scale (``loss_scaler.py:10``)."""
+
+    def __init__(self, scale: float = 1.0):
+        self.cur_scale = float(scale)
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def has_overflow(self, params_or_grads) -> bool:
+        return False
+
+    def update_scale(self, overflow: bool):
+        pass
+
+    def scale_gradient(self, grads):
+        return jax.tree.map(lambda g: g * self.cur_scale, grads)
+
+    def backward(self, loss):
+        raise NotImplementedError("compute grads of loss * loss_scale in JAX")
+
+
+class DynamicLossScaler(LossScaler):
+    """Dynamic scale (``loss_scaler.py:47``): halve on overflow, double
+    every ``scale_window`` clean iterations."""
+
+    def __init__(self, init_scale=2 ** 32, scale_factor=2.0, scale_window=1000):
+        super().__init__(init_scale)
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+
+    def has_overflow(self, grads) -> bool:
+        return not bool(tree_all_finite(grads))
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.cur_scale = max(self.cur_scale / self.scale_factor, 1.0)
+            self.last_overflow_iter = self.cur_iter
+        elif (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0 \
+                and self.cur_iter > self.last_overflow_iter:
+            self.cur_scale *= self.scale_factor
+        self.cur_iter += 1

@@ -1,0 +1,57 @@
+"""Single-node multi-process launcher.
+
+Reference: ``apex/parallel/multiproc.py:12-35`` — spawn one training
+process per GPU with ``--rank``/``--world-size`` appended.
+
+TPU reality: one process drives all local chips (SPMD), and multi-host
+jobs are launched by the TPU infrastructure with
+``jax.distributed.initialize()``. This launcher exists for parity and for
+multi-process CPU simulation: it spawns ``world_size`` processes with the
+coordinator env set so ``jax.distributed.initialize`` connects them.
+
+Usage: ``python -m apex_tpu.parallel.multiproc [--world-size N] script.py args...``
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    world_size = None
+    if argv and argv[0] == "--world-size":
+        world_size = int(argv[1])
+        argv = argv[2:]
+    if not argv:
+        print(__doc__)
+        return 1
+    if world_size is None:
+        try:
+            import jax
+            world_size = jax.local_device_count()
+        except Exception:
+            world_size = 1
+
+    port = int(os.environ.get("APEX_TPU_COORD_PORT", "12355"))
+    procs = []
+    for rank in range(world_size):
+        env = dict(os.environ)
+        env.update({
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "JAX_NUM_PROCESSES": str(world_size),
+            "JAX_PROCESS_ID": str(rank),
+        })
+        cmd = [sys.executable] + argv + ["--rank", str(rank),
+                                         "--world-size", str(world_size)]
+        procs.append(subprocess.Popen(cmd, env=env))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

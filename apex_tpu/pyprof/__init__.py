@@ -1,0 +1,16 @@
+"""apex_tpu.pyprof — profiling/annotation layer on jax.profiler + XLA.
+
+Reference: ``apex/pyprof`` (deprecated in apex) — three parts:
+``nvtx`` (annotate every op with name/args/callstack,
+``apex/pyprof/nvtx/nvmarker.py:67-108,206``), ``parse`` (read the nvprof
+SQLite DB), ``prof`` (map kernels to op semantics, compute FLOPs/bytes,
+``apex/pyprof/prof/*.py``).
+
+TPU mapping: annotation = ``jax.profiler`` trace annotations (visible in
+TensorBoard/XProf, replacing NVTX); parse/prof = XLA's own cost analysis
+on the compiled executable (FLOPs/bytes per program without re-deriving
+them from kernel names).
+"""
+
+from apex_tpu.pyprof.nvtx import annotate, init, wrap  # noqa: F401
+from apex_tpu.pyprof.prof import cost_analysis, flop_report, trace  # noqa: F401

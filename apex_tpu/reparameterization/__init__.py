@@ -1,0 +1,18 @@
+"""apex_tpu.reparameterization — weight normalization.
+
+Reference: ``apex/reparameterization/__init__.py:4``
+(``apply_weight_norm`` installing forward pre-hooks,
+``reparameterization.py:4``, ``weight_norm.py`` — w = g · v/||v||).
+
+TPU/functional form: hooks become an explicit param-tree transform:
+``apply_weight_norm(params)`` splits selected kernels into (v, g);
+``materialize_weights`` rebuilds w (called inside the model's apply via
+``reparameterized_apply``); ``remove_weight_norm`` collapses back.
+"""
+
+from apex_tpu.reparameterization.weight_norm import (  # noqa: F401
+    apply_weight_norm,
+    remove_weight_norm,
+    materialize_weights,
+    reparameterized_apply,
+)

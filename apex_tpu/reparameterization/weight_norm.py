@@ -1,0 +1,75 @@
+"""Weight-norm reparameterization as param-tree transforms.
+
+Reference semantics (``apex/reparameterization/weight_norm.py``): a
+weight w is stored as direction v and magnitude g with
+``w = g * v / ||v||`` (norm over all dims except the output dim); the
+hook recomputes w before each forward so the optimizer trains (v, g).
+
+Functional design: params are rewritten so each selected kernel leaf
+becomes ``{"_wn_v": v, "_wn_g": g}``; ``materialize_weights`` folds them
+back to dense kernels (inside jit, fused to nothing); gradients w.r.t.
+(v, g) follow by autodiff — exactly the hook's math without mutation.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _norm_except_last(v):
+    axes = tuple(range(v.ndim - 1))
+    return jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32)), axis=axes, keepdims=True))
+
+
+def _default_filter(path, leaf) -> bool:
+    return hasattr(leaf, "ndim") and leaf.ndim >= 2 and \
+        path and path[-1].lower() in ("kernel", "weight", "embedding")
+
+
+def apply_weight_norm(params, name_filter: Optional[Callable] = None, dim: int = -1):
+    """Split selected kernels into (v, g). ``dim`` kept for API parity;
+    the norm is over all non-output dims (torch dim=0 equivalent for our
+    [in..., out] layout)."""
+    name_filter = name_filter or _default_filter
+
+    def walk(path, tree):
+        if isinstance(tree, dict):
+            return {k: walk(path + (k,), v) for k, v in tree.items()}
+        if name_filter(path, tree):
+            g = _norm_except_last(tree)
+            v = tree
+            return {"_wn_v": v, "_wn_g": g.astype(tree.dtype)}
+        return tree
+
+    return walk((), params)
+
+
+def materialize_weights(params):
+    """Rebuild dense kernels from (v, g) leaves."""
+    def walk(tree):
+        if isinstance(tree, dict):
+            if set(tree.keys()) == {"_wn_v", "_wn_g"}:
+                v, g = tree["_wn_v"], tree["_wn_g"]
+                w = v.astype(jnp.float32) / jnp.maximum(_norm_except_last(v), 1e-12)
+                return (w * g.astype(jnp.float32)).astype(v.dtype)
+            return {k: walk(v) for k, v in tree.items()}
+        return tree
+
+    return walk(params)
+
+
+def remove_weight_norm(params):
+    """Collapse (v, g) back to plain kernels
+    (``apex/reparameterization/__init__.py remove_weight_norm``)."""
+    return materialize_weights(params)
+
+
+def reparameterized_apply(apply_fn):
+    """Wrap ``apply_fn(params, ...)`` to materialize weight-normed params
+    first — the functional analog of the forward pre-hook."""
+    def wrapped(params, *args, **kwargs):
+        return apply_fn(materialize_weights(params), *args, **kwargs)
+    return wrapped

@@ -1,0 +1,11 @@
+"""apex_tpu.rnn — fusion-friendly RNN/LSTM/GRU/mLSTM.
+
+Reference: ``apex/RNN`` (``apex/RNN/models.py:8`` ``toRNNBackend``,
+``RNNBackend.py:25-365`` cell/stack/bidirectional machinery,
+``cells.py:12`` mLSTM). A pure-python reimplementation whose cells are
+single fused expressions — on TPU each cell is one ``lax.scan`` step that
+XLA fuses, which is the entire point of the reference's rewrite.
+"""
+
+from apex_tpu.rnn.models import LSTM, GRU, RNNReLU, RNNTanh, mLSTM, toRNNBackend  # noqa: F401
+from apex_tpu.rnn.cells import LSTMCell, GRUCell, RNNCell, mLSTMCell  # noqa: F401

@@ -1,0 +1,125 @@
+"""RNN cells as single fused step functions.
+
+Reference: ``apex/RNN/RNNBackend.py:25`` (RNNCell with pluggable gate
+math) and ``apex/RNN/cells.py:12`` (``mLSTMRNNCell`` — multiplicative
+LSTM, Krause et al. 2016: an intermediate state m = (W_mx x) * (W_mh h)
+modulates the recurrent path).
+
+Each cell is ``cell(params, carry, x) -> (carry, y)`` — a pure function
+suitable as a ``lax.scan`` body; parameters are plain dicts created by
+``cell.init_params``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _glorot(key, shape):
+    fan_in, fan_out = shape[0], shape[-1]
+    lim = np.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, jnp.float32, -lim, lim)
+
+
+class _CellBase:
+    gates: int = 1
+
+    def __init__(self, input_size: int, hidden_size: int, bias: bool = True,
+                 output_size: int | None = None):
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.bias = bias
+        self.output_size = output_size
+
+    def init_params(self, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        g = self.gates
+        p = {
+            "w_ih": _glorot(k1, (self.input_size, g * self.hidden_size)),
+            "w_hh": _glorot(k2, (self.hidden_size, g * self.hidden_size)),
+        }
+        if self.bias:
+            p["b_ih"] = jnp.zeros((g * self.hidden_size,), jnp.float32)
+            p["b_hh"] = jnp.zeros((g * self.hidden_size,), jnp.float32)
+        if self.output_size is not None:
+            p["w_ho"] = _glorot(k3, (self.hidden_size, self.output_size))
+        return p
+
+    def init_carry(self, batch):
+        return jnp.zeros((batch, self.hidden_size), jnp.float32)
+
+    def _lin(self, p, x, h):
+        z = x @ p["w_ih"] + h @ p["w_hh"]
+        if self.bias:
+            z = z + p["b_ih"] + p["b_hh"]
+        return z
+
+    def _out(self, p, h):
+        return h @ p["w_ho"] if self.output_size is not None else h
+
+
+class RNNCell(_CellBase):
+    gates = 1
+
+    def __init__(self, *args, nonlinearity=jnp.tanh, **kw):
+        super().__init__(*args, **kw)
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, p, h, x):
+        h = self.nonlinearity(self._lin(p, x, h))
+        return h, self._out(p, h)
+
+
+class LSTMCell(_CellBase):
+    gates = 4
+
+    def init_carry(self, batch):
+        z = jnp.zeros((batch, self.hidden_size), jnp.float32)
+        return (z, z)
+
+    def __call__(self, p, carry, x):
+        h, c = carry
+        z = self._lin(p, x, h)
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), self._out(p, h)
+
+
+class GRUCell(_CellBase):
+    gates = 3
+
+    def __call__(self, p, h, x):
+        xz = x @ p["w_ih"] + (p["b_ih"] if self.bias else 0.0)
+        hz = h @ p["w_hh"] + (p["b_hh"] if self.bias else 0.0)
+        xr, xu, xn = jnp.split(xz, 3, axis=-1)
+        hr, hu, hn = jnp.split(hz, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        u = jax.nn.sigmoid(xu + hu)
+        n = jnp.tanh(xn + r * hn)
+        h = (1 - u) * n + u * h
+        return h, self._out(p, h)
+
+
+class mLSTMCell(LSTMCell):
+    """Multiplicative LSTM (``apex/RNN/cells.py:12``)."""
+
+    def init_params(self, key):
+        k1, k2, kr = jax.random.split(key, 3)
+        p = super().init_params(k1)
+        p["w_mx"] = _glorot(k2, (self.input_size, self.hidden_size))
+        p["w_mh"] = _glorot(kr, (self.hidden_size, self.hidden_size))
+        return p
+
+    def __call__(self, p, carry, x):
+        h, c = carry
+        m = (x @ p["w_mx"]) * (h @ p["w_mh"])
+        z = x @ p["w_ih"] + m @ p["w_hh"]
+        if self.bias:
+            z = z + p["b_ih"] + p["b_hh"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h = jax.nn.sigmoid(o) * jnp.tanh(c)
+        return (h, c), self._out(p, h)

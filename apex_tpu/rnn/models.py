@@ -1,0 +1,102 @@
+"""Stacked / bidirectional RNN containers over ``lax.scan``.
+
+Reference: ``apex/RNN/RNNBackend.py`` — ``stackedRNN`` (:227),
+``bidirectionalRNN`` (:150), dropout between layers, and
+``apex/RNN/models.py:8`` ``toRNNBackend`` factory returning
+LSTM/GRU/ReLU/Tanh/mLSTM networks. Inputs are [seq, batch, features]
+like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.rnn.cells import GRUCell, LSTMCell, RNNCell, mLSTMCell
+
+
+class RNNBackend:
+    def __init__(self, cells, dropout: float = 0.0, bidirectional: bool = False):
+        self.cells = cells  # list per layer; bidirectional → list of (fwd, bwd)
+        self.dropout = dropout
+        self.bidirectional = bidirectional
+
+    def init_params(self, key):
+        params = []
+        for cell in self.cells:
+            if self.bidirectional:
+                kf, kb, key = jax.random.split(key, 3)
+                params.append({"fwd": cell[0].init_params(kf),
+                               "bwd": cell[1].init_params(kb)})
+            else:
+                k, key = jax.random.split(key)
+                params.append(cell.init_params(k))
+        return params
+
+    def _run_one(self, cell, p, x, reverse=False):
+        batch = x.shape[1]
+        carry0 = cell.init_carry(batch)
+
+        def body(carry, xt):
+            carry, y = cell(p, carry, xt)
+            return carry, y
+
+        _, ys = jax.lax.scan(body, carry0, x, reverse=reverse)
+        return ys
+
+    def __call__(self, params, x, *, key=None, deterministic: bool = True):
+        h = x
+        for li, p in enumerate(params):
+            if self.bidirectional:
+                fw = self._run_one(self.cells[li][0], p["fwd"], h)
+                bw = self._run_one(self.cells[li][1], p["bwd"], h, reverse=True)
+                h = jnp.concatenate([fw, bw], axis=-1)
+            else:
+                h = self._run_one(self.cells[li], p, h)
+            if self.dropout > 0 and not deterministic and li < len(params) - 1:
+                if key is None:
+                    raise ValueError("dropout requires key")
+                key, sub = jax.random.split(key)
+                keep = jax.random.bernoulli(sub, 1 - self.dropout, h.shape)
+                h = jnp.where(keep, h / (1 - self.dropout), 0.0)
+        return h
+
+
+def toRNNBackend(cell_cls, input_size, hidden_size, num_layers: int = 1,
+                 bias: bool = True, dropout: float = 0.0,
+                 bidirectional: bool = False, output_size=None, **cell_kw):
+    """Factory mirroring ``apex/RNN/models.py:8``."""
+    cells = []
+    for i in range(num_layers):
+        mult = 2 if bidirectional else 1
+        in_sz = input_size if i == 0 else hidden_size * mult
+        if bidirectional:
+            cells.append((cell_cls(in_sz, hidden_size, bias, **cell_kw),
+                          cell_cls(in_sz, hidden_size, bias, **cell_kw)))
+        else:
+            cells.append(cell_cls(in_sz, hidden_size, bias, **cell_kw))
+    return RNNBackend(cells, dropout, bidirectional)
+
+
+def LSTM(input_size, hidden_size, num_layers=1, **kw):
+    return toRNNBackend(LSTMCell, input_size, hidden_size, num_layers, **kw)
+
+
+def GRU(input_size, hidden_size, num_layers=1, **kw):
+    return toRNNBackend(GRUCell, input_size, hidden_size, num_layers, **kw)
+
+
+def RNNTanh(input_size, hidden_size, num_layers=1, **kw):
+    return toRNNBackend(RNNCell, input_size, hidden_size, num_layers,
+                        nonlinearity=jnp.tanh, **kw)
+
+
+def RNNReLU(input_size, hidden_size, num_layers=1, **kw):
+    return toRNNBackend(RNNCell, input_size, hidden_size, num_layers,
+                        nonlinearity=jax.nn.relu, **kw)
+
+
+def mLSTM(input_size, hidden_size, num_layers=1, **kw):
+    return toRNNBackend(mLSTMCell, input_size, hidden_size, num_layers, **kw)

@@ -1,0 +1,126 @@
+"""Aux-subsystem tests: fp16_utils, RNN, weight norm, pyprof analog.
+
+Mirrors the reference's coverage for these packages (RNN casting tests in
+``tests/L0/run_amp/test_rnn.py``, fp16util conversions, weight-norm
+reparameterization behavior).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import fp16_utils, pyprof
+from apex_tpu.rnn import LSTM, GRU, mLSTM, RNNReLU
+from apex_tpu.reparameterization import (
+    apply_weight_norm, materialize_weights, reparameterized_apply, remove_weight_norm)
+
+
+def test_convert_network_keeps_bn_fp32():
+    params = {"conv": {"kernel": jnp.zeros((3, 3), jnp.float32)},
+              "BatchNorm_0": {"scale": jnp.ones((3,), jnp.float32)}}
+    out = fp16_utils.convert_network(params, jnp.bfloat16)
+    assert out["conv"]["kernel"].dtype == jnp.bfloat16
+    assert out["BatchNorm_0"]["scale"].dtype == jnp.float32
+    full = fp16_utils.network_to_half(params)
+    assert full["BatchNorm_0"]["scale"].dtype == jnp.bfloat16
+
+
+def test_prep_param_lists_and_copyback():
+    params = {"w": jnp.ones((4,), jnp.bfloat16)}
+    model_p, master_p = fp16_utils.prep_param_lists(params)
+    assert master_p["w"].dtype == jnp.float32
+    master_p = {"w": master_p["w"] + 0.001}
+    back = fp16_utils.master_params_to_model_params(model_p, master_p)
+    assert back["w"].dtype == jnp.bfloat16
+
+
+def test_fp16_optimizer_wrapper():
+    from apex_tpu.optimizers import FusedSGD
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    opt = fp16_utils.FP16_Optimizer(FusedSGD(params, lr=0.1),
+                                    dynamic_loss_scale=True)
+    scaled = opt.scale_loss(jnp.asarray(1.0))
+    assert float(scaled) == 2.0 ** 32
+    g = {"w": jnp.full((4,), float(scaled))}   # grad of scaled loss
+    new_p = opt.step(g)
+    np.testing.assert_allclose(np.asarray(new_p["w"]), 0.9, rtol=1e-6)
+    # overflow path
+    opt.step({"w": jnp.full((4,), np.inf)})
+    assert opt.overflow
+    sd = opt.state_dict()
+    assert "loss_scaler" in sd
+
+
+def test_fp16_optimizer_clip_master_grads():
+    from apex_tpu.optimizers import FusedSGD
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    opt = fp16_utils.FP16_Optimizer(FusedSGD(params, lr=0.1))
+    g = {"w": jnp.full((4,), 10.0)}
+    clipped, norm = opt.clip_master_grads(1.0, g)
+    np.testing.assert_allclose(float(norm), 20.0, rtol=1e-5)
+    np.testing.assert_allclose(
+        float(jnp.linalg.norm(clipped["w"])), 1.0, rtol=1e-4)
+
+
+def test_rnn_variants_shapes_and_grads():
+    s, b, i, h = 6, 3, 5, 4
+    x = jnp.asarray(np.random.RandomState(0).randn(s, b, i), jnp.float32)
+    for net_fn in (LSTM, GRU, mLSTM, RNNReLU):
+        net = net_fn(i, h, num_layers=2)
+        params = net.init_params(jax.random.PRNGKey(0))
+        y = net(params, x)
+        assert y.shape == (s, b, h)
+        g = jax.grad(lambda p: jnp.sum(net(p, x) ** 2))(params)
+        assert all(np.isfinite(np.asarray(l)).all() for l in jax.tree.leaves(g))
+
+
+def test_bidirectional_rnn():
+    from apex_tpu.rnn import toRNNBackend, LSTMCell
+    net = toRNNBackend(LSTMCell, 5, 4, num_layers=1, bidirectional=True)
+    params = net.init_params(jax.random.PRNGKey(1))
+    x = jnp.ones((6, 2, 5))
+    y = net(params, x)
+    assert y.shape == (6, 2, 8)
+
+
+def test_weight_norm_roundtrip():
+    rng = np.random.RandomState(2)
+    params = {"dense": {"kernel": jnp.asarray(rng.randn(5, 3), jnp.float32),
+                        "bias": jnp.zeros((3,), jnp.float32)}}
+    wn = apply_weight_norm(params)
+    assert set(wn["dense"]["kernel"].keys()) == {"_wn_v", "_wn_g"}
+    assert wn["dense"]["bias"].shape == (3,)
+    dense = materialize_weights(wn)
+    np.testing.assert_allclose(np.asarray(dense["dense"]["kernel"]),
+                               np.asarray(params["dense"]["kernel"]), rtol=1e-5)
+    back = remove_weight_norm(wn)
+    np.testing.assert_allclose(np.asarray(back["dense"]["kernel"]),
+                               np.asarray(params["dense"]["kernel"]), rtol=1e-5)
+
+
+def test_weight_norm_apply_and_grads():
+    params = {"kernel": jnp.asarray([[3.0, 0.0], [0.0, 4.0]], jnp.float32)}
+    wn = apply_weight_norm(params, name_filter=lambda p, l: p[-1] == "kernel")
+
+    apply_fn = reparameterized_apply(lambda p, x: x @ p["kernel"])
+    y = apply_fn(wn, jnp.ones((1, 2)))
+    np.testing.assert_allclose(np.asarray(y), [[3.0, 4.0]], rtol=1e-5)
+    g = jax.grad(lambda p: jnp.sum(apply_fn(p, jnp.ones((1, 2)))))(wn)
+    assert np.isfinite(np.asarray(g["kernel"]["_wn_g"])).all()
+
+
+def test_pyprof_cost_analysis_and_annotate():
+    def f(x):
+        return (x @ x.T).sum()
+
+    x = jnp.ones((32, 32), jnp.float32)
+    ca = pyprof.cost_analysis(f, x)
+    # 32x32x32 matmul ≈ 2*32^3 flops (backend-dependent accounting ≥ n^3)
+    assert ca.get("flops", 0) >= 32 ** 3
+    rep = pyprof.flop_report(f, x, step_time_s=1e-3, peak_flops=1e12)
+    assert "mfu" in rep and rep["arithmetic_intensity"] > 0
+
+    with pyprof.annotate("test_region", note=1):
+        _ = f(x)
+    wrapped = pyprof.wrap(f, "wrapped_f")
+    assert float(wrapped(x)) == float(f(x))
