@@ -85,4 +85,6 @@ class SpatialBottleneck(nn.Module):
         y = norm(self.filters * self.expansion, "n3")(y, use_running_average=ura)
         if residual.shape[-1] != self.filters * self.expansion:
             residual = conv(self.filters * self.expansion, (1, 1), "proj")(x)
+            residual = norm(self.filters * self.expansion, "n4")(
+                residual, use_running_average=ura)
         return jax.nn.relu(y + residual)

@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm, create_syncbn_process_group
@@ -20,8 +21,8 @@ class BatchNorm2d_NHWC(nn.Module):
     num_features: int
     fuse_relu: bool = False
     bn_group: int = 1
-    world_size: int = 1            # for group construction
-    momentum: float = 0.9          # torch bn momentum convention: 1-m below
+    world_size: Optional[int] = None   # inferred from the mesh axis if unset
+    momentum: float = 0.1              # torch convention, as SyncBatchNorm
     eps: float = 1e-5
     axis_name: Optional[str] = "data"
     param_dtype: Any = jnp.float32
@@ -30,12 +31,17 @@ class BatchNorm2d_NHWC(nn.Module):
     def __call__(self, x, z=None, use_running_average: bool = False):
         groups = None
         axis = self.axis_name if self.bn_group > 1 else None
-        if self.bn_group > 1 and self.world_size > self.bn_group:
-            groups = create_syncbn_process_group(self.bn_group, self.world_size)
+        if self.bn_group > 1:
+            ws = self.world_size
+            if ws is None:
+                # psum of 1 is the (static) axis size at trace time
+                ws = int(jax.lax.psum(1, self.axis_name))
+            if ws > self.bn_group:
+                groups = create_syncbn_process_group(self.bn_group, ws)
         bn = SyncBatchNorm(
             num_features=self.num_features,
             eps=self.eps,
-            momentum=1.0 - self.momentum,
+            momentum=self.momentum,
             axis_name=axis,
             axis_index_groups=groups,
             fuse_relu=self.fuse_relu,

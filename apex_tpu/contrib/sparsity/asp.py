@@ -30,7 +30,8 @@ def _default_whitelist(path_names, leaf) -> bool:
     name = path_names[-1].lower() if path_names else ""
     if name in ("bias", "scale"):
         return False
-    return leaf.shape[-1] % 4 == 0 and leaf.shape[-1] >= 16
+    # the mask is cut along the reduction dim (axis -2 of JAX kernels)
+    return leaf.shape[-2] % 4 == 0 and leaf.shape[-2] >= 16
 
 
 class ASP:

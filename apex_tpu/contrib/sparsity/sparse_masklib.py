@@ -13,19 +13,28 @@ import jax.numpy as jnp
 
 
 def m4n2_1d(w, *_args, **_kw):
-    """2:4 mask along the last dim (keep the 2 largest |w| of each 4)."""
+    """2:4 mask along the reduction dim (keep the 2 largest |w| of each 4)."""
     return create_mask(w, pattern="2:4")
 
 
-def create_mask(w, pattern: str = "2:4"):
+def create_mask(w, pattern: str = "2:4", axis: int = -2):
+    """N:M mask by magnitude along ``axis``.
+
+    The reference prunes along the *input/reduction* dimension — torch
+    weights are [out, in] so it groups the last dim; JAX kernels are
+    [..., in, out], so the reduction dim is ``-2`` here. That is the dim a
+    sparse dot-product contraction actually skips.
+    """
     n, m = (int(s) for s in pattern.split(":"))
-    *lead, last = w.shape
-    if last % m:
-        raise ValueError(f"last dim {last} not divisible by group size {m}")
-    g = w.reshape(*lead, last // m, m)
+    axis = axis % w.ndim
+    if w.shape[axis] % m:
+        raise ValueError(
+            f"dim {axis} of size {w.shape[axis]} not divisible by group size {m}")
+    wt = jnp.moveaxis(w, axis, -1)
+    g = wt.reshape(*wt.shape[:-1], wt.shape[-1] // m, m)
     mag = jnp.abs(g.astype(jnp.float32))
     # rank within each group; keep the n largest magnitudes
     order = jnp.argsort(mag, axis=-1)            # ascending
     ranks = jnp.argsort(order, axis=-1)          # rank of each element
     mask = ranks >= (m - n)
-    return mask.reshape(w.shape)
+    return jnp.moveaxis(mask.reshape(wt.shape), -1, axis)

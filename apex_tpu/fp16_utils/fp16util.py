@@ -12,6 +12,9 @@ exemption uses the same name predicate as amp O2.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+from typing import Any
+
 import jax
 import jax.numpy as jnp
 
@@ -30,29 +33,49 @@ def convert_network(params, dtype=jnp.bfloat16):
     return cast_floating(params, dtype, lambda names, x: not _is_norm_param(names))
 
 
+@dataclass
+class FlatMaster:
+    """Flat fp32 master copy + the spec needed to unpack it back into the
+    model-param tree (the reference's ``flat_master=True`` form, which
+    keeps one contiguous fp32 tensor, ``fp16util.py:96-106``)."""
+
+    flat: jax.Array
+    spec: Any
+
+    def to_tree(self):
+        return self.spec.unpack(self.flat, dtype_from_spec=False)
+
+
 def prep_param_lists(params, flat_master: bool = False):
     """Return (model_params, master_params) where master is an fp32 copy
-    (``fp16util.py:78-128``); ``flat_master`` returns one flat fp32 vector
-    like the reference's flattened option."""
+    (``fp16util.py:78-128``); ``flat_master`` returns a :class:`FlatMaster`
+    (one contiguous fp32 buffer) like the reference's flattened option."""
     master = cast_floating(params, jnp.float32)
     if flat_master:
         from apex_tpu.utils.flat import FlatBuffer
         spec = FlatBuffer.from_tree(master)
-        return params, spec.pack(master, dtype=jnp.float32)
+        return params, FlatMaster(spec.pack(master, dtype=jnp.float32), spec)
     return params, master
 
 
 def master_params_to_model_params(model_params, master_params):
     """Downcast master values into the model param dtypes
     (``fp16util.py:130-144``)."""
+    if isinstance(master_params, FlatMaster):
+        master_params = master_params.to_tree()
     return jax.tree.map(
         lambda mp, ma: ma.astype(mp.dtype) if jnp.issubdtype(mp.dtype, jnp.floating) else ma,
         model_params, master_params)
 
 
-def model_grads_to_master_grads(model_grads):
-    """fp16 grads -> fp32 master grads (``fp16util.py:146-162``)."""
-    return cast_floating(model_grads, jnp.float32)
+def model_grads_to_master_grads(model_grads, flat_spec=None):
+    """fp16 grads -> fp32 master grads (``fp16util.py:146-162``); pass the
+    :class:`FlatMaster` spec to get grads in the flat form."""
+    master = cast_floating(model_grads, jnp.float32)
+    if flat_spec is not None:
+        spec = flat_spec.spec if isinstance(flat_spec, FlatMaster) else flat_spec
+        return FlatMaster(spec.pack(master, dtype=jnp.float32), spec)
+    return master
 
 
 def to_python_float(t):

@@ -61,10 +61,13 @@ def mha_reference(q, k, v, *, causal=False, segment_ids_q=None,
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(sq_ref, skv_ref, q_ref, k_ref, v_ref,  # inputs
-                o_ref, lse_ref,                        # outputs
-                m_scr, l_scr, acc_scr,                 # scratch
-                *, scale, causal, block_q, block_k, use_segments, kv_len):
+def _fwd_kernel(*refs, scale, causal, block_q, block_k, use_segments, kv_len):
+    if use_segments:
+        sq_ref, skv_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, \
+            m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        sq_ref = skv_ref = None
     kb = pl.program_id(3)
     n_kb = pl.num_programs(3)
 
@@ -87,9 +90,9 @@ def _fwd_kernel(sq_ref, skv_ref, q_ref, k_ref, v_ref,  # inputs
         # offset aligns the ends for cross-length causal
         mask &= k_pos <= q_pos + (kv_len - pl.num_programs(2) * block_q)
     if use_segments:
-        sid_q = sq_ref[0]                             # [block_q]
-        sid_k = skv_ref[0]                            # [block_k]
-        mask &= sid_q[:, None] == sid_k[None, :]
+        sid_q = sq_ref[0]                             # [block_q, 1]
+        sid_k = skv_ref[0]                            # [1, block_k]
+        mask &= sid_q == sid_k
     s = jnp.where(mask, s, _NEG_INF)
 
     m_prev = m_scr[:]                                 # [block_q, 1]
@@ -111,7 +114,7 @@ def _fwd_kernel(sq_ref, skv_ref, q_ref, k_ref, v_ref,  # inputs
         l = l_scr[:]
         safe_l = jnp.where(l > 0, l, 1.0)
         o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_scr[:] + jnp.log(safe_l))[:, 0]
+        lse_ref[0, 0] = m_scr[:] + jnp.log(safe_l)    # [block_q, 1]
 
 
 def _flash_fwd(q, k, v, segment_ids_q, segment_ids_kv, scale, causal,
@@ -124,34 +127,44 @@ def _flash_fwd(q, k, v, segment_ids_q, segment_ids_kv, scale, causal,
         raise ValueError(f"seq lens ({sq},{sk}) must be divisible by blocks "
                          f"({block_q},{block_k})")
     use_segments = segment_ids_q is not None
-    if not use_segments:
-        segment_ids_q = jnp.zeros((b, sq), jnp.int32)
-        segment_ids_kv = jnp.zeros((b, sk), jnp.int32)
-    elif segment_ids_kv is None:
-        segment_ids_kv = segment_ids_q
 
     grid = (b, h, sq // block_q, sk // block_k)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, use_segments=use_segments, kv_len=sk)
 
+    # Mosaic requires the last two block dims to be (8k, 128k) or equal to
+    # the array dims — trailing-singleton layouts (b, sq, 1) / (b, 1, sk)
+    # tile the 1D id vectors with no broadcast cost.
+    in_specs = []
+    operands = []
+    if use_segments:
+        if segment_ids_kv is None:
+            segment_ids_kv = segment_ids_q
+        in_specs += [
+            pl.BlockSpec((1, block_q, 1), lambda b_, h_, qi, ki: (b_, qi, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b_, h_, qi, ki: (b_, 0, ki)),
+        ]
+        operands += [segment_ids_q[:, :, None], segment_ids_kv[:, None, :]]
+    in_specs += [
+        pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
+    ]
+    operands += [q.reshape(b, h, sq, d), k.reshape(b, h, sk, d),
+                 v.reshape(b, h, sk, d)]
+
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q), lambda b_, h_, qi, ki: (b_, qi)),
-            pl.BlockSpec((1, block_k), lambda b_, h_, qi, ki: (b_, ki)),
-            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b_, h_, qi, ki: (b_, h_, qi)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -159,9 +172,8 @@ def _flash_fwd(q, k, v, segment_ids_q, segment_ids_kv, scale, causal,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(segment_ids_q, segment_ids_kv, q.reshape(b, h, sq, d),
-      k.reshape(b, h, sk, d), v.reshape(b, h, sk, d))
-    return out, lse
+    )(*operands)
+    return out, lse[..., 0]
 
 
 # ---------------------------------------------------------------------------

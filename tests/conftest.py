@@ -20,4 +20,8 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# The env var alone is not enough when a sitecustomize registers a PJRT
+# plugin and overwrites jax_platforms at interpreter start — update the
+# config directly (before any backend is initialized by a test).
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")

@@ -79,12 +79,12 @@ def test_transducer_loss_grad_finite():
 
 def test_create_mask_2of4():
     rng = np.random.RandomState(3)
-    w = jnp.asarray(rng.randn(8, 16), jnp.float32)
-    m = create_mask(w)
-    mm = np.asarray(m).reshape(8, 4, 4)
+    w = jnp.asarray(rng.randn(16, 8), jnp.float32)   # [in, out] kernel
+    m = create_mask(w)                                # 2:4 along in (axis -2)
+    mm = np.asarray(m).T.reshape(8, 4, 4)
     assert (mm.sum(-1) == 2).all()
-    # kept entries are the two largest |w| per group
-    wa = np.abs(np.asarray(w)).reshape(8, 4, 4)
+    # kept entries are the two largest |w| per group of 4 input weights
+    wa = np.abs(np.asarray(w)).T.reshape(8, 4, 4)
     for i in range(8):
         for gidx in range(4):
             kept = set(np.where(mm[i, gidx])[0])
@@ -95,8 +95,8 @@ def test_create_mask_2of4():
 def test_asp_masks_persist_through_optimizer():
     from apex_tpu.optimizers import FusedSGD
     rng = np.random.RandomState(4)
-    params = {"dense": {"kernel": jnp.asarray(rng.randn(8, 16), jnp.float32),
-                        "bias": jnp.zeros((16,), jnp.float32)}}
+    params = {"dense": {"kernel": jnp.asarray(rng.randn(16, 8), jnp.float32),
+                        "bias": jnp.zeros((8,), jnp.float32)}}
     ASP.init_model_for_pruning(params)
     masks = ASP.compute_sparse_masks(params)
     params = ASP.apply_masks(params)
