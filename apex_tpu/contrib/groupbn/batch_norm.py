@@ -34,8 +34,14 @@ class BatchNorm2d_NHWC(nn.Module):
         if self.bn_group > 1:
             ws = self.world_size
             if ws is None:
-                # psum of 1 is the (static) axis size at trace time
-                ws = int(jax.lax.psum(1, self.axis_name))
+                try:
+                    # static axis size at trace time
+                    ws = jax.lax.axis_size(self.axis_name)
+                except NameError:
+                    # e.g. Module.init outside shard_map — single device,
+                    # no group construction (same guard as SyncBatchNorm)
+                    ws = 1
+                    axis = None
             if ws > self.bn_group:
                 groups = create_syncbn_process_group(self.bn_group, ws)
         bn = SyncBatchNorm(

@@ -71,11 +71,12 @@ def master_params_to_model_params(model_params, master_params):
 def model_grads_to_master_grads(model_grads, flat_spec=None):
     """fp16 grads -> fp32 master grads (``fp16util.py:146-162``); pass the
     :class:`FlatMaster` spec to get grads in the flat form."""
-    master = cast_floating(model_grads, jnp.float32)
     if flat_spec is not None:
+        # pack() casts while copying into the flat buffer — no
+        # intermediate fp32 tree
         spec = flat_spec.spec if isinstance(flat_spec, FlatMaster) else flat_spec
-        return FlatMaster(spec.pack(master, dtype=jnp.float32), spec)
-    return master
+        return FlatMaster(spec.pack(model_grads, dtype=jnp.float32), spec)
+    return cast_floating(model_grads, jnp.float32)
 
 
 def to_python_float(t):

@@ -10,3 +10,5 @@ BERT-style encoder (config 4), GPT (config 5).
 from apex_tpu.models.mlp import SimpleMLP  # noqa: F401
 from apex_tpu.models.resnet import ResNet, ResNet18, ResNet50, ResNet101  # noqa: F401
 from apex_tpu.models.gpt import GPT, GPTConfig  # noqa: F401
+from apex_tpu.models.bert import Bert, BertBase, BertConfig, BertLarge  # noqa: F401
+from apex_tpu.models.dcgan import Discriminator, Generator  # noqa: F401

@@ -1,0 +1,167 @@
+"""BERT-style bidirectional encoder (BASELINE config 4: BERT-base + FusedLAMB).
+
+The reference has no BERT implementation — apex is the *utility* layer NVIDIA's
+BERT recipes build on (FusedLAMB `apex/optimizers/fused_lamb.py`, fused
+softmax `csrc/megatron/scaled_masked_softmax.h`, FusedLayerNorm, fused
+dense). This model assembles exactly those apex_tpu pieces into the encoder
+those recipes train, so the LAMB/fused-layer path has a realistic workload.
+
+TPU notes: attention uses the Pallas flash kernel with padding expressed as
+segment ids (packed-varlen FMHA analog, `apex/contrib/fmha/fmha.py:33-58`);
+falls back to FusedScaleMaskSoftmax scores when ``use_flash=False``. All
+matmuls accumulate fp32 on the MXU via ``preferred_element_type``. TP-capable
+through Column/RowParallelLinear — runs unchanged at tp=1 and tp=k.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.ops import flash_attention
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30528          # padded to a multiple of 64 for the MXU
+    max_seq_len: int = 512
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden_size: Optional[int] = None
+    type_vocab_size: int = 2
+    dtype: Any = jnp.bfloat16
+    use_flash: bool = True
+    remat_blocks: bool = False
+
+    @property
+    def ffn(self):
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+
+def BertBase(**kw) -> "Bert":
+    return Bert(BertConfig(**kw))
+
+
+def BertLarge(**kw) -> "Bert":
+    return Bert(BertConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw))
+
+
+class BertSelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, pad_mask):
+        """``pad_mask``: [b, s] bool, True = real token."""
+        cfg = self.cfg
+        h = cfg.hidden_size
+        tp = ps.get_tensor_model_parallel_world_size()
+        heads_per = cfg.num_heads // tp
+        head_dim = h // cfg.num_heads
+
+        qkv = ColumnParallelLinear(
+            input_size=h, output_size=3 * h, gather_output=False,
+            name="qkv")(x)
+        b, s, _ = qkv.shape
+        qkv = qkv.reshape(b, s, heads_per, 3 * head_dim)
+        q, k, v = jnp.split(qkv, 3, axis=-1)          # [b, s, hp, d]
+
+        if cfg.use_flash:
+            # padding → segment ids: real tokens segment 1, pads -1 (the
+            # kernel zeroes their rows and excludes them as keys).
+            sids = jnp.where(pad_mask, 1, -1).astype(jnp.int32)
+            ctx = flash_attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3),
+                segment_ids_q=sids, segment_ids_kv=sids,
+                causal=False, scale=head_dim ** -0.5)
+            ctx = ctx.transpose(0, 2, 1, 3).astype(cfg.dtype)
+        else:
+            scores = jnp.einsum("bshd,bthd->bhst", q, k,
+                                preferred_element_type=jnp.float32)
+            softmax = FusedScaleMaskSoftmax(
+                input_in_bf16=cfg.dtype == jnp.bfloat16,
+                attn_mask_type=AttnMaskType.padding,
+                scale=head_dim ** -0.5)
+            mask = ~pad_mask[:, None, None, :]        # True = masked out
+            probs = softmax(scores.astype(cfg.dtype), mask)
+            ctx = jnp.einsum("bhst,bthd->bshd", probs.astype(cfg.dtype), v,
+                             preferred_element_type=jnp.float32
+                             ).astype(cfg.dtype)
+        ctx = ctx.reshape(b, s, heads_per * head_dim)
+        return RowParallelLinear(
+            input_size=h, output_size=h, input_is_parallel=True,
+            name="proj")(ctx)
+
+
+class BertLayer(nn.Module):
+    """Post-LN transformer layer (original BERT residual order)."""
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, pad_mask):
+        cfg = self.cfg
+        a = BertSelfAttention(cfg, name="attn")(x, pad_mask)
+        x = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln1")(
+            (x + a).astype(jnp.float32)).astype(cfg.dtype)
+        y = ColumnParallelLinear(
+            input_size=cfg.hidden_size, output_size=cfg.ffn,
+            gather_output=False, name="fc1")(x)
+        y = jax.nn.gelu(y.astype(jnp.float32), approximate=True).astype(cfg.dtype)
+        y = RowParallelLinear(
+            input_size=cfg.ffn, output_size=cfg.hidden_size,
+            input_is_parallel=True, name="fc2")(y)
+        return FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln2")(
+            (x + y).astype(jnp.float32)).astype(cfg.dtype)
+
+
+class Bert(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, ids, pad_mask=None, type_ids=None):
+        """Returns [b, s, V/tp] MLM logits (tied to the embedding shard)."""
+        cfg = self.cfg
+        if pad_mask is None:
+            pad_mask = jnp.ones(ids.shape, bool)
+        wte = VocabParallelEmbedding(
+            num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
+            name="wte")
+        x = wte(ids).astype(cfg.dtype)
+        pos = self.param("wpe", nn.initializers.normal(0.02),
+                         (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
+        x = x + pos[None, :ids.shape[1]].astype(cfg.dtype)
+        if cfg.type_vocab_size:
+            tok_type = self.param(
+                "wtte", nn.initializers.normal(0.02),
+                (cfg.type_vocab_size, cfg.hidden_size), jnp.float32)
+            if type_ids is None:
+                x = x + tok_type[0].astype(cfg.dtype)
+            else:
+                x = x + jnp.take(tok_type, type_ids, axis=0).astype(cfg.dtype)
+        x = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln_emb")(
+            x.astype(jnp.float32)).astype(cfg.dtype)
+
+        layer_cls = nn.remat(BertLayer) if cfg.remat_blocks else BertLayer
+        for i in range(cfg.num_layers):
+            x = layer_cls(cfg, name=f"layer_{i}")(x, pad_mask)
+
+        # MLM transform head (dense+gelu+LN), then tied decoder
+        x = ColumnParallelLinear(
+            input_size=cfg.hidden_size, output_size=cfg.hidden_size,
+            gather_output=True, name="mlm_dense")(x)
+        x = jax.nn.gelu(x.astype(jnp.float32), approximate=True)
+        x = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="mlm_ln")(
+            x).astype(cfg.dtype)
+        return wte.attend(x)

@@ -1,0 +1,69 @@
+"""DCGAN generator/discriminator (reference ``examples/dcgan/main_amp.py``).
+
+The reference's dcgan example exists to exercise amp with *multiple models,
+multiple optimizers, multiple losses* (``amp.initialize([netD, netG],
+[optD, optG], num_losses=3)``); these flax modules fill the same role for
+``examples/dcgan`` here. NHWC layout throughout (TPU conv-friendly);
+BatchNorm stays fp32 under O2 via the amp keep-batchnorm policy.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Generator(nn.Module):
+    """z [b, 1, 1, nz] → image [b, isize, isize, nc] in (-1, 1)."""
+
+    nz: int = 100
+    ngf: int = 64
+    nc: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, z, train: bool = True):
+        x = z.astype(self.dtype)
+        norm = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=not train, momentum=0.9, epsilon=1e-5,
+            dtype=jnp.float32, name=name)
+        # 1x1 → 4x4 → 8x8 → 16x16 → 32x32 → 64x64
+        x = nn.ConvTranspose(self.ngf * 8, (4, 4), (1, 1), padding="VALID",
+                             use_bias=False, dtype=self.dtype, name="up1")(x)
+        x = nn.relu(norm("bn1")(x).astype(self.dtype))
+        for i, mult in enumerate((4, 2, 1), start=2):
+            x = nn.ConvTranspose(self.ngf * mult, (4, 4), (2, 2),
+                                 padding="SAME", use_bias=False,
+                                 dtype=self.dtype, name=f"up{i}")(x)
+            x = nn.relu(norm(f"bn{i}")(x).astype(self.dtype))
+        x = nn.ConvTranspose(self.nc, (4, 4), (2, 2), padding="SAME",
+                             use_bias=False, dtype=self.dtype, name="out")(x)
+        return jnp.tanh(x.astype(jnp.float32))
+
+
+class Discriminator(nn.Module):
+    """image [b, 64, 64, nc] → logit [b]."""
+
+    ndf: int = 64
+    nc: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, img, train: bool = True):
+        x = img.astype(self.dtype)
+        norm = lambda name: nn.BatchNorm(  # noqa: E731
+            use_running_average=not train, momentum=0.9, epsilon=1e-5,
+            dtype=jnp.float32, name=name)
+        x = nn.Conv(self.ndf, (4, 4), (2, 2), padding="SAME", use_bias=False,
+                    dtype=self.dtype, name="conv1")(x)
+        x = nn.leaky_relu(x.astype(jnp.float32), 0.2).astype(self.dtype)
+        for i, mult in enumerate((2, 4, 8), start=2):
+            x = nn.Conv(self.ndf * mult, (4, 4), (2, 2), padding="SAME",
+                        use_bias=False, dtype=self.dtype, name=f"conv{i}")(x)
+            x = nn.leaky_relu(
+                norm(f"bn{i}")(x).astype(jnp.float32), 0.2).astype(self.dtype)
+        x = nn.Conv(1, (4, 4), (1, 1), padding="VALID", use_bias=False,
+                    dtype=self.dtype, name="out")(x)
+        return x.reshape(x.shape[0]).astype(jnp.float32)  # logits

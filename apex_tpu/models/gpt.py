@@ -53,7 +53,7 @@ class ParallelSelfAttention(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         h = cfg.hidden_size
-        tp = ps._axis_size(ps.TENSOR_AXIS)
+        tp = ps.get_tensor_model_parallel_world_size()
         heads_per = cfg.num_heads // tp
         head_dim = h // cfg.num_heads
 

@@ -51,9 +51,13 @@ def mha_reference(q, k, v, *, causal=False, segment_ids_q=None,
         s = jnp.where(cm, _NEG_INF, s)
     if segment_ids_q is not None:
         sid_kv = segment_ids_q if segment_ids_kv is None else segment_ids_kv
-        seg = segment_ids_q[:, None, :, None] == sid_kv[:, None, None, :]
+        seg = ((segment_ids_q[:, None, :, None] == sid_kv[:, None, None, :])
+               & (segment_ids_q >= 0)[:, None, :, None])
         s = jnp.where(seg, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if segment_ids_q is not None:
+        # fully-masked (padding, id<0) rows: zeros, not uniform attention
+        p = jnp.where(seg.any(axis=-1, keepdims=True), p, 0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
@@ -61,7 +65,8 @@ def mha_reference(q, k, v, *, causal=False, segment_ids_q=None,
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(*refs, scale, causal, block_q, block_k, use_segments, kv_len):
+def _fwd_kernel(*refs, scale, causal, block_q, block_k, use_segments,
+                causal_offset):
     if use_segments:
         sq_ref, skv_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, \
             m_scr, l_scr, acc_scr = refs
@@ -87,12 +92,13 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, use_segments, kv_len):
     k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
     mask = jnp.ones((block_q, block_k), jnp.bool_)
     if causal:
-        # offset aligns the ends for cross-length causal
-        mask &= k_pos <= q_pos + (kv_len - pl.num_programs(2) * block_q)
+        # offset aligns the (original, pre-padding) sequence ends
+        mask &= k_pos <= q_pos + causal_offset
     if use_segments:
         sid_q = sq_ref[0]                             # [block_q, 1]
         sid_k = skv_ref[0]                            # [1, block_k]
-        mask &= sid_q == sid_k
+        # negative ids are padding: they match nothing, not even each other
+        mask &= (sid_q == sid_k) & (sid_q >= 0)
     s = jnp.where(mask, s, _NEG_INF)
 
     m_prev = m_scr[:]                                 # [block_q, 1]
@@ -121,17 +127,34 @@ def _flash_fwd(q, k, v, segment_ids_q, segment_ids_kv, scale, causal,
                block_q, block_k, interpret):
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    causal_offset = sk - sq   # aligns the original sequence ends
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k:
-        raise ValueError(f"seq lens ({sq},{sk}) must be divisible by blocks "
-                         f"({block_q},{block_k})")
+    # Arbitrary lengths: pad seq dims up to block multiples; padded
+    # positions get segment id -1, which the kernel masks out entirely.
+    pad_q = -sq % block_q
+    pad_k = -sk % block_k
+    if pad_q or pad_k:
+        if segment_ids_q is None:
+            segment_ids_q = jnp.zeros((b, sq), jnp.int32)
+            segment_ids_kv = jnp.zeros((b, sk), jnp.int32)
+        elif segment_ids_kv is None:
+            segment_ids_kv = segment_ids_q
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad_k), (0, 0)))
+        segment_ids_q = jnp.pad(segment_ids_q, ((0, 0), (0, pad_q)),
+                                constant_values=-1)
+        segment_ids_kv = jnp.pad(segment_ids_kv, ((0, 0), (0, pad_k)),
+                                 constant_values=-1)
+    sq_p, sk_p = sq + pad_q, sk + pad_k
     use_segments = segment_ids_q is not None
 
-    grid = (b, h, sq // block_q, sk // block_k)
+    grid = (b, h, sq_p // block_q, sk_p // block_k)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, use_segments=use_segments, kv_len=sk)
+        block_k=block_k, use_segments=use_segments,
+        causal_offset=causal_offset)
 
     # Mosaic requires the last two block dims to be (8k, 128k) or equal to
     # the array dims — trailing-singleton layouts (b, sq, 1) / (b, 1, sk)
@@ -151,8 +174,7 @@ def _flash_fwd(q, k, v, segment_ids_q, segment_ids_kv, scale, causal,
         pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
         pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
     ]
-    operands += [q.reshape(b, h, sq, d), k.reshape(b, h, sk, d),
-                 v.reshape(b, h, sk, d)]
+    operands += [q, k, v]
 
     out, lse = pl.pallas_call(
         kernel,
@@ -163,8 +185,8 @@ def _flash_fwd(q, k, v, segment_ids_q, segment_ids_kv, scale, causal,
             pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq_p, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -173,7 +195,7 @@ def _flash_fwd(q, k, v, segment_ids_q, segment_ids_kv, scale, causal,
         ],
         interpret=interpret,
     )(*operands)
-    return out, lse[..., 0]
+    return out[:, :, :sq], lse[:, :, :sq, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -188,11 +210,15 @@ def _bwd_math(res, do, *, scale, causal):
     mask = jnp.ones(s.shape[-2:], jnp.bool_)
     if causal:
         mask &= ~(jnp.arange(sk)[None, :] > jnp.arange(sq)[:, None] + (sk - sq))
-    s = jnp.where(mask, s, _NEG_INF)
     if sid_q is not None:
-        seg = sid_q[:, None, :, None] == sid_kv[:, None, None, :]
-        s = jnp.where(seg, s, _NEG_INF)
-    p = jnp.exp(s - lse[..., None])                      # exact softmax via saved lse
+        if sid_kv is None:
+            sid_kv = sid_q
+        seg = ((sid_q[:, None, :, None] == sid_kv[:, None, None, :])
+               & (sid_q >= 0)[:, None, :, None])
+        mask = mask & seg
+    # exact softmax via saved lse; explicit zero where masked (a fully
+    # masked padding row has lse == _NEG_INF, so exp(s - lse) would be 1)
+    p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)
     do32 = do.astype(jnp.float32)
     dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
     dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v.astype(jnp.float32))
@@ -215,8 +241,10 @@ def flash_attention(q, k, v, segment_ids_q=None, segment_ids_kv=None,
     """Fused attention. Returns [b, h, sq, d].
 
     ``segment_ids_*``: packed-varlen support (FMHA cu_seqlens analog) —
-    tokens attend only within equal segment ids; id -1 rows are padding
-    (they attend nothing and produce zeros).
+    tokens attend only within equal *non-negative* segment ids; negative
+    ids are padding: they match nothing (not even each other), attend
+    nothing, and produce zero output rows. Sequence lengths need not be
+    multiples of the block sizes (inputs are padded internally).
     """
     out, _ = _fa_fwd(q, k, v, segment_ids_q, segment_ids_kv, causal, scale,
                      block_q, block_k, interpret)

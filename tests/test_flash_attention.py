@@ -83,7 +83,40 @@ def test_flash_bf16():
                                rtol=5e-2, atol=5e-2)
 
 
-def test_flash_rejects_indivisible():
-    q, k, v = _qkv(sq=33, sk=33)
-    with pytest.raises(ValueError):
-        flash_attention(q, k, v, block_q=32, block_k=32)
+def test_flash_indivisible_lengths_padded():
+    """Lengths that don't divide the block size are padded internally."""
+    for causal in (False, True):
+        q, k, v = _qkv(sq=33, sk=33)
+        out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        ref = mha_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_flash_negative_segment_ids_are_padding():
+    """id < 0 rows: zero output, no influence on real rows, zero grads in."""
+    b, h, s, d = 1, 2, 32, 8
+    q, k, v = _qkv(b, h, s, s, d, seed=5)
+    sid = jnp.asarray(np.repeat([[1] * 20 + [-1] * 12], b, 0))
+
+    out = flash_attention(q, k, v, segment_ids_q=sid, block_q=16, block_k=16)
+    np.testing.assert_array_equal(np.asarray(out[:, :, 20:]), 0.0)
+
+    # pad tokens must not leak into real rows: perturb padded k/v
+    k2 = k.at[:, :, 20:].add(100.0)
+    v2 = v.at[:, :, 20:].add(100.0)
+    out2 = flash_attention(q, k2, v2, segment_ids_q=sid, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(out[:, :, :20]),
+                               np.asarray(out2[:, :, :20]), rtol=1e-5, atol=1e-6)
+
+    # gradients w.r.t. padded positions are exactly zero even when the
+    # cotangent is nonzero there (lse of an empty row must not produce
+    # exp(0)=1 weights in the backward)
+    def f(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, segment_ids_q=sid,
+                                       block_q=16, block_k=16))
+    dq, dk, dv = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_array_equal(np.asarray(dq[:, :, 20:]), 0.0)
+    np.testing.assert_array_equal(np.asarray(dk[:, :, 20:]), 0.0)
+    np.testing.assert_array_equal(np.asarray(dv[:, :, 20:]), 0.0)
+    assert np.isfinite(np.asarray(dq)).all()

@@ -1,0 +1,133 @@
+"""L1-tier: convergence sweep across the precision-policy cross product.
+
+Reference: ``tests/L1/run_test.sh:19-80`` sweeps opt_level x loss_scale x
+keep_batchnorm on ResNet-50, records baseline losses on the first config
+and asserts later configs agree within threshold (``compare.py``). Here the
+model is small enough for CI, the baseline is the O0 run, and every other
+opt level must track it — the same doctrine at unit-test cost.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp import scaler as scaler_mod
+from apex_tpu.models import SimpleMLP
+from apex_tpu.optimizers import FusedSGD
+
+
+def train(opt_level, loss_scale=None, steps=60, seed=0):
+    model = SimpleMLP(features=(16, 32, 32, 1), activation="none")
+    amp_model, optimizer = amp.initialize(
+        model.apply, FusedSGD(lr=0.005, momentum=0.9),
+        opt_level=opt_level, loss_scale=loss_scale, verbosity=0)
+    scaler = optimizer._amp_stash.loss_scalers[0]
+
+    rng = np.random.RandomState(seed)
+    w_true = rng.randn(16, 1).astype(np.float32) * 0.5
+    variables = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, 16)))
+    params = amp_model.cast_params(variables)["params"]
+    opt_state = optimizer.init(params)
+    sstate = scaler.state
+
+    @jax.jit
+    def step(params, opt_state, sstate, x, y):
+        def lf(p):
+            pred = amp_model({"params": p}, x)
+            return jnp.mean((pred.astype(jnp.float32) - y) ** 2)
+
+        grads, loss = jax.grad(
+            lambda p: (lambda l: (scaler_mod.scale_value(l, sstate), l))(lf(p)),
+            has_aux=True)(params)
+        grads, found_inf = scaler_mod.unscale(grads, sstate)
+        params, opt_state = optimizer.apply(opt_state, params, grads,
+                                            skip=found_inf)
+        return params, opt_state, scaler.update_state(sstate, found_inf), loss
+
+    losses = []
+    for _ in range(steps):
+        x = rng.randn(256, 16).astype(np.float32)
+        y = x @ w_true
+        params, opt_state, sstate, loss = step(
+            params, opt_state, sstate, jnp.asarray(x), jnp.asarray(y))
+        losses.append(float(loss))
+    return losses
+
+
+BASELINE = None
+
+
+def baseline():
+    global BASELINE
+    if BASELINE is None:
+        BASELINE = train("O0")
+    return BASELINE
+
+
+@pytest.mark.parametrize("opt_level,loss_scale", [
+    ("O0", None),
+    ("O1", None), ("O1", "dynamic"),
+    ("O2", None), ("O2", "dynamic"), ("O2", 128.0),
+    ("O3", None),
+])
+def test_cross_product_tracks_baseline(opt_level, loss_scale):
+    ref = baseline()
+    got = train(opt_level, loss_scale)
+    # every config must converge...
+    assert got[-1] < 0.05, f"{opt_level}/{loss_scale} final loss {got[-1]}"
+    # ...and track the fp32 baseline trajectory within bf16 slack
+    end_ref = np.mean(ref[-10:])
+    end_got = np.mean(got[-10:])
+    assert abs(end_got - end_ref) < 0.05, (
+        f"{opt_level}/{loss_scale}: {end_got} vs baseline {end_ref}")
+
+
+def test_dynamic_scaler_recovers_from_overflow():
+    """Inject an inf gradient mid-training (the only 'fault' apex handles,
+    SURVEY §5): the step must be skipped, the scale halved, and training
+    must continue to converge."""
+    model = SimpleMLP(features=(4, 8, 1), activation="none")
+    amp_model, optimizer = amp.initialize(
+        model.apply, FusedSGD(lr=0.02), opt_level="O2",
+        loss_scale="dynamic", verbosity=0)
+    scaler = optimizer._amp_stash.loss_scalers[0]
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4)))
+    params = amp_model.cast_params(variables)["params"]
+    opt_state = optimizer.init(params)
+    sstate = scaler.state
+    scale0 = float(sstate.loss_scale)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(64, 4).astype(np.float32))
+    y = jnp.asarray(rng.randn(64, 1).astype(np.float32))
+
+    @jax.jit
+    def step(params, opt_state, sstate, x, y, poison):
+        def lf(p):
+            pred = amp_model({"params": p}, x)
+            return jnp.mean((pred.astype(jnp.float32) - y) ** 2)
+
+        grads = jax.grad(lambda p: scaler_mod.scale_value(lf(p), sstate))(params)
+        grads = jax.tree.map(lambda g: g + poison, grads)
+        grads, found_inf = scaler_mod.unscale(grads, sstate)
+        params, opt_state = optimizer.apply(opt_state, params, grads,
+                                            skip=found_inf)
+        return params, opt_state, scaler.update_state(sstate, found_inf)
+
+    params, opt_state, sstate = step(params, opt_state, sstate, x, y,
+                                     jnp.asarray(0.0))
+    p_before = jax.tree.map(np.asarray, params)
+    params, opt_state, sstate = step(params, opt_state, sstate, x, y,
+                                     jnp.asarray(np.inf))
+    # skipped: params unchanged, scale halved
+    for a, b in zip(jax.tree_util.tree_leaves(p_before),
+                    jax.tree_util.tree_leaves(params)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+    assert float(sstate.loss_scale) == scale0 / 2
+    # and training continues cleanly
+    params, opt_state, sstate = step(params, opt_state, sstate, x, y,
+                                     jnp.asarray(0.0))
+    assert all(np.isfinite(np.asarray(l, np.float32)).all()
+               for l in jax.tree_util.tree_leaves(params))
