@@ -1,0 +1,91 @@
+"""Native host-runtime bindings (ctypes over ``csrc/apex_tpu_native.cpp``).
+
+The reference builds ~20 pybind11 extensions via setup.py flags
+(``setup.py:53-522``); here the single host-side shared library is built
+lazily with g++ on first use and cached under ``csrc/build/``. Everything
+has a pure-python fallback, mirroring apex's "Python-only build"
+(reference ``README.md:130-139``): ``lib()`` returns None when no
+compiler is available, and callers degrade gracefully.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "csrc", "apex_tpu_native.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(_SRC), "build")
+_SO = os.path.join(_BUILD_DIR, "libapex_tpu_native.so")
+
+
+def _build() -> str | None:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = _SO + ".tmp"
+    # built lazily on the machine that runs it, so -march=native is safe
+    cmd = ["g++", "-O3", "-march=native", "-funroll-loops", "-std=c++17",
+           "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+    except (OSError, subprocess.SubprocessError):
+        try:  # portable fallback flags
+            subprocess.run(["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                            "-pthread", _SRC, "-o", tmp],
+                           check=True, capture_output=True, timeout=300)
+        except (OSError, subprocess.SubprocessError):
+            return None
+    os.replace(tmp, _SO)
+    return _SO
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    i8p, u8p = c.POINTER(c.c_int64), c.POINTER(c.c_uint8)
+    f32p, u16p = c.POINTER(c.c_float), c.POINTER(c.c_uint16)
+    vp = c.c_void_p
+
+    lib.atp_version.restype = c.c_int
+    lib.atp_flatten.argtypes = [c.POINTER(vp), i8p, c.c_int64, u8p, c.c_int]
+    lib.atp_unflatten.argtypes = [u8p, i8p, c.c_int64, c.POINTER(vp), c.c_int]
+    lib.atp_f32_to_bf16.argtypes = [f32p, u16p, c.c_int64, c.c_int]
+    lib.atp_transform_batch_args.argtypes = [
+        u8p, i8p, c.c_int64, c.c_int64, c.c_int64, c.c_int64, c.c_int64,
+        c.c_int64, f32p, f32p, c.c_int, c.c_int, vp, c.c_uint64, c.c_int]
+    lib.atp_loader_create.restype = vp
+    lib.atp_loader_create.argtypes = [
+        u8p, c.c_int64, c.c_int64, c.c_int64, c.c_int64, c.c_int64,
+        f32p, f32p, c.c_int, c.c_int, c.c_int64, c.c_int, c.c_int, c.c_int]
+    lib.atp_loader_submit.argtypes = [vp, i8p, c.c_int64, c.c_uint64]
+    lib.atp_loader_next.restype = c.c_int64
+    lib.atp_loader_next.argtypes = [vp, u8p]
+    lib.atp_loader_destroy.argtypes = [vp]
+    return lib
+
+
+def lib() -> ctypes.CDLL | None:
+    """The loaded native library, or None if it can't be built here."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is None and not _tried:
+            _tried = True
+            so = _build()
+            if so is not None:
+                try:
+                    _lib = _bind(ctypes.CDLL(so))
+                except OSError:
+                    _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return lib() is not None

@@ -1,0 +1,19 @@
+"""apex_tpu.data — host-side input pipeline.
+
+The reference's imagenet example gets its throughput from a C++/CUDA
+loader stack (DALI or torchvision+prefetcher with pinned memory,
+``examples/imagenet/main_amp.py``). On TPU the input pipeline is routinely
+the MFU ceiling (SURVEY §7 risks), and the GIL makes pure-python
+per-image work a bottleneck — so the transform/prefetch core here is C++
+(``csrc/apex_tpu_native.cpp``), with a numpy fallback when no compiler
+exists (apex's "Python-only build" doctrine).
+"""
+
+from apex_tpu.data.loader import (  # noqa: F401
+    DataLoader,
+    transform_batch,
+    f32_to_bf16,
+    flatten,
+    unflatten,
+    native_available,
+)

@@ -1,0 +1,291 @@
+"""Threaded prefetching data loader + host tensor utilities.
+
+Native core in ``csrc/apex_tpu_native.cpp`` via ctypes; every entry point
+has a numpy fallback so the package works without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from apex_tpu import _native
+
+_BF16_VIEW = np.uint16
+
+
+def native_available() -> bool:
+    return _native.available()
+
+
+# ---------------------------------------------------------------------------
+# flatten / unflatten (apex_C parity: csrc/flatten_unflatten.cpp)
+# ---------------------------------------------------------------------------
+
+def flatten(arrays: Sequence[np.ndarray], n_threads: int = 4) -> np.ndarray:
+    """Concatenate host arrays' bytes into one flat uint8 buffer."""
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    sizes = np.asarray([a.nbytes for a in arrays], np.int64)
+    total = int(sizes.sum())
+    out = np.empty(total, np.uint8)
+    lib = _native.lib()
+    if lib is None or not arrays:
+        off = 0
+        for a, s in zip(arrays, sizes):
+            out[off:off + s] = a.view(np.uint8).reshape(-1)
+            off += s
+        return out
+    ptrs = (ctypes.c_void_p * len(arrays))(
+        *[a.ctypes.data_as(ctypes.c_void_p) for a in arrays])
+    lib.atp_flatten(ptrs, sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                    len(arrays), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                    n_threads)
+    return out
+
+
+def unflatten(flat: np.ndarray, templates: Sequence[np.ndarray],
+              n_threads: int = 4) -> list[np.ndarray]:
+    """Split a flat uint8 buffer back into arrays shaped like ``templates``."""
+    flat = np.ascontiguousarray(flat.view(np.uint8).reshape(-1))
+    outs = [np.empty_like(np.ascontiguousarray(t)) for t in templates]
+    sizes = np.asarray([o.nbytes for o in outs], np.int64)
+    if flat.nbytes != int(sizes.sum()):
+        raise ValueError(f"flat buffer has {flat.nbytes} bytes, templates "
+                         f"need {int(sizes.sum())}")
+    lib = _native.lib()
+    if lib is None or not outs:
+        off = 0
+        for o, s in zip(outs, sizes):
+            o.view(np.uint8).reshape(-1)[:] = flat[off:off + s]
+            off += s
+        return outs
+    ptrs = (ctypes.c_void_p * len(outs))(
+        *[o.ctypes.data_as(ctypes.c_void_p) for o in outs])
+    lib.atp_unflatten(flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                      sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                      len(outs), ptrs, n_threads)
+    return outs
+
+
+def f32_to_bf16(x: np.ndarray, n_threads: int = 4) -> np.ndarray:
+    """Round-to-nearest-even fp32→bf16; returns a uint16 bit-pattern array
+    (viewable as ml_dtypes.bfloat16). Halves host→device transfer bytes."""
+    x = np.ascontiguousarray(x, np.float32)
+    out = np.empty(x.shape, _BF16_VIEW)
+    lib = _native.lib()
+    if lib is None:
+        import ml_dtypes
+        return x.astype(ml_dtypes.bfloat16).view(np.uint16)
+    lib.atp_f32_to_bf16(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        x.size, n_threads)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# batch transform
+# ---------------------------------------------------------------------------
+
+def transform_batch(images: np.ndarray, indices: np.ndarray, out_h: int,
+                    out_w: int, mean: Sequence[float], std: Sequence[float],
+                    *, out_bf16: bool = False, augment: bool = False,
+                    seed: int = 0, n_threads: int = 4) -> np.ndarray:
+    """Gather ``images[indices]``, crop to (out_h, out_w) (random if
+    ``augment`` else center), random-hflip (augment), normalize
+    ``(x/255 - mean)/std``. uint8 NHWC in, fp32/bf16 NHWC out."""
+    images = np.ascontiguousarray(images)
+    if images.dtype != np.uint8 or images.ndim != 4:
+        raise ValueError("images must be uint8 [N, H, W, C]")
+    n = len(indices)
+    _, sh, sw, c = images.shape
+    if c > 8:
+        raise ValueError("at most 8 channels")
+    indices = np.ascontiguousarray(indices, np.int64)
+    mean32 = np.ascontiguousarray(mean, np.float32)
+    std32 = np.ascontiguousarray(std, np.float32)
+    out = np.empty((n, out_h, out_w, c), _BF16_VIEW if out_bf16 else np.float32)
+    lib = _native.lib()
+    if lib is None:
+        return _transform_batch_py(images, indices, out_h, out_w, mean32,
+                                   std32, out_bf16, augment, seed)
+    lib.atp_transform_batch_args(
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, sh, sw, c, out_h, out_w,
+        mean32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        std32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        int(out_bf16), int(augment),
+        out.ctypes.data_as(ctypes.c_void_p), seed, n_threads)
+    return out
+
+
+def _transform_batch_py(images, indices, out_h, out_w, mean, std, out_bf16,
+                        augment, seed):
+    n = len(indices)
+    _, sh, sw, c = images.shape
+    rng = np.random.RandomState(seed & 0x7fffffff)
+    out32 = np.empty((n, out_h, out_w, c), np.float32)
+    for i, idx in enumerate(indices):
+        if augment:
+            y0 = rng.randint(0, sh - out_h + 1)
+            x0 = rng.randint(0, sw - out_w + 1)
+            flip = bool(rng.randint(2))
+        else:
+            y0, x0, flip = (sh - out_h) // 2, (sw - out_w) // 2, False
+        img = images[idx, y0:y0 + out_h, x0:x0 + out_w]
+        if flip:
+            img = img[:, ::-1]
+        out32[i] = (img.astype(np.float32) / 255.0 - mean) / std
+    if out_bf16:
+        return f32_to_bf16(out32)
+    return out32
+
+
+# ---------------------------------------------------------------------------
+# DataLoader
+# ---------------------------------------------------------------------------
+
+class DataLoader:
+    """Prefetching loader over an in-memory uint8 image array.
+
+    ``for x, y in DataLoader(images, labels, batch_size=128, ...)`` — the
+    C++ worker pool keeps ``prefetch`` transformed batches ready while the
+    accelerator step runs (DALI/prefetcher analog of the reference's
+    imagenet pipeline). Falls back to synchronous numpy transforms plus a
+    python prefetch thread without the native lib.
+    """
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 batch_size: int, *, crop: Optional[tuple[int, int]] = None,
+                 mean: Sequence[float] = (0.485, 0.456, 0.406),
+                 std: Sequence[float] = (0.229, 0.224, 0.225),
+                 out_bf16: bool = False, augment: bool = True,
+                 shuffle: bool = True, drop_last: bool = True,
+                 seed: int = 0, prefetch: int = 4, workers: int = 2,
+                 inner_threads: int = 4):
+        if images.dtype != np.uint8 or images.ndim != 4:
+            raise ValueError("images must be uint8 [N, H, W, C]")
+        if len(images) != len(labels):
+            raise ValueError("images/labels length mismatch")
+        self.images = np.ascontiguousarray(images)
+        self.labels = np.ascontiguousarray(labels)
+        self.batch_size = batch_size
+        n, sh, sw, c = self.images.shape
+        self.crop = crop or (sh, sw)
+        self.mean, self.std = tuple(mean[:c]), tuple(std[:c])
+        self.out_bf16 = out_bf16
+        self.augment = augment
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.prefetch = max(1, prefetch)
+        self.workers = max(1, workers)
+        self.inner_threads = max(1, inner_threads)
+        self._epoch = 0
+
+    def __len__(self) -> int:
+        n = len(self.images)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def _epoch_indices(self) -> np.ndarray:
+        idx = np.arange(len(self.images), dtype=np.int64)
+        if self.shuffle:
+            np.random.RandomState((self.seed + self._epoch) & 0x7fffffff).shuffle(idx)
+        return idx
+
+    def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        self._epoch += 1
+        idx = self._epoch_indices()
+        batches = [idx[i * self.batch_size:(i + 1) * self.batch_size]
+                   for i in range(len(self))]
+        if not self.drop_last and len(idx) % self.batch_size:
+            pass  # len() already included the ragged tail
+        lib = _native.lib()
+        if lib is not None:
+            yield from self._iter_native(lib, batches)
+        else:
+            yield from self._iter_python(batches)
+
+    def _iter_native(self, lib, batches):
+        n, sh, sw, c = self.images.shape
+        oh, ow = self.crop
+        mean32 = np.ascontiguousarray(self.mean, np.float32)
+        std32 = np.ascontiguousarray(self.std, np.float32)
+        # ragged tails get their own slot size via per-batch loaders being
+        # overkill — instead pad capacity to max batch and slice on yield
+        max_b = max(len(b) for b in batches)
+        handle = lib.atp_loader_create(
+            self.images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            sh, sw, c, oh, ow,
+            mean32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            std32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            int(self.out_bf16), int(self.augment), max_b,
+            self.prefetch, self.workers, self.inner_threads)
+        if not handle:
+            yield from self._iter_python(batches)
+            return
+        dtype = _BF16_VIEW if self.out_bf16 else np.float32
+        itemsize = 2 if self.out_bf16 else 4
+        slot_bytes = max_b * oh * ow * c * itemsize
+        try:
+            submitted = 0
+            next_out = 0
+            padded = []
+            for b in batches:
+                pb = b if len(b) == max_b else np.concatenate(
+                    [b, np.zeros(max_b - len(b), np.int64)])
+                padded.append((pb, len(b)))
+            while next_out < len(padded):
+                while (submitted < len(padded)
+                       and submitted - next_out < self.prefetch):
+                    pb, _ = padded[submitted]
+                    lib.atp_loader_submit(
+                        handle,
+                        pb.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                        max_b,
+                        (self.seed + self._epoch * 131071 + submitted) & (2**64 - 1))
+                    submitted += 1
+                buf = np.empty(slot_bytes, np.uint8)
+                got = lib.atp_loader_next(
+                    handle, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+                if got < 0:
+                    raise RuntimeError("native loader shut down")
+                real = padded[next_out][1]
+                x = buf.view(dtype).reshape(max_b, oh, ow, c)[:real]
+                y = self.labels[batches[next_out]]
+                next_out += 1
+                yield x, y
+        finally:
+            lib.atp_loader_destroy(handle)
+
+    def _iter_python(self, batches):
+        import queue as _q
+        q: _q.Queue = _q.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+
+        def producer():
+            for bi, b in enumerate(batches):
+                if stop.is_set():
+                    return
+                x = transform_batch(
+                    self.images, b, *self.crop, self.mean, self.std,
+                    out_bf16=self.out_bf16, augment=self.augment,
+                    seed=(self.seed + self._epoch * 131071 + bi),
+                    n_threads=self.inner_threads)
+                q.put((x, self.labels[b]))
+            q.put(None)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                yield item
+        finally:
+            stop.set()

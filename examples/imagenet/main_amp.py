@@ -66,21 +66,32 @@ def parse_args():
     p.add_argument("--save", default=None, help="checkpoint path")
     p.add_argument("--resume", default=None, help="checkpoint path")
     p.add_argument("--validate-steps", type=int, default=2)
+    p.add_argument("--dataset-size", type=int, default=512,
+                   help="synthetic dataset size")
     return p.parse_args()
 
 
 def synthetic_batches(args, n_dev, seed=0):
-    """Deterministic fake-ImageNet stream (class-dependent mean so top-1
-    actually improves): the stand-in for the reference's DALI/folder
-    pipeline in a zero-egress environment."""
+    """Fake-ImageNet through the real input pipeline: a synthetic uint8
+    dataset (class-dependent brightness so top-1 actually improves) fed to
+    ``apex_tpu.data.DataLoader`` — C++ threaded prefetch/augment/normalize
+    when the native lib builds, numpy fallback otherwise (the DALI-stack
+    analog of the reference's pipeline, zero-egress)."""
+    from apex_tpu.data import DataLoader
     rng = np.random.RandomState(seed)
     b = args.batch_size * n_dev
-    means = rng.randn(args.num_classes, 3).astype(np.float32)
+    n = max(args.dataset_size, b)
+    side = args.image_size + args.image_size // 8  # pre-crop margin
+    labels = rng.randint(0, args.num_classes, n).astype(np.int32)
+    images = rng.randint(0, 64, (n, side, side, 3), dtype=np.uint8)
+    offs = np.linspace(0, 191, args.num_classes).astype(np.uint8)
+    images += offs[labels][:, None, None, None]
+    loader = DataLoader(images, labels, b,
+                        crop=(args.image_size, args.image_size),
+                        augment=True, shuffle=True, seed=seed,
+                        prefetch=4, workers=2)
     while True:
-        labels = rng.randint(0, args.num_classes, (b,))
-        x = rng.randn(b, args.image_size, args.image_size, 3).astype(np.float32)
-        x = x + means[labels][:, None, None, :] * 2.0
-        yield x, labels.astype(np.int32)
+        yield from loader
 
 
 def main():
