@@ -29,7 +29,10 @@ def _build() -> str | None:
     if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
         return _SO
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    tmp = _SO + ".tmp"
+    # per-process tmp name: concurrent builders (pytest-xdist, multi-host
+    # on a shared FS) each write their own file; os.replace stays atomic
+    # and last-writer-wins with a complete .so
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     # built lazily on the machine that runs it, so -march=native is safe
     cmd = ["g++", "-O3", "-march=native", "-funroll-loops", "-std=c++17",
            "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp]

@@ -20,13 +20,17 @@ def cu_seqlens_to_segment_ids(cu_seqlens, total: int):
 
 
 def fmha_varlen(qkv, cu_seqlens, *, causal: bool = False,
-                scale: float | None = None, block: int = 128):
+                scale: float | None = None, block: int = 128,
+                dropout_rate: float = 0.0, dropout_seed=None):
     """qkv: [total, 3, h, d] packed batch. Returns [total, h, d].
 
     ``total`` should be padded to a block multiple; pad tokens get a
     segment id of their own trailing segment and attend only themselves
     (their outputs are garbage to be masked by the caller, same contract
     as the reference's packed layout).
+
+    ``dropout_rate``/``dropout_seed``: in-kernel attention dropout
+    (reference p_dropout plumbing, ``fmha_api.cpp:67-110``).
     """
     total, three, h, d = qkv.shape
     if three != 3:
@@ -37,7 +41,9 @@ def fmha_varlen(qkv, cu_seqlens, *, causal: bool = False,
     v = qkv[:, 2].transpose(1, 0, 2)[None]
     out = flash_attention(q, k, v, segment_ids_q=sids, causal=causal,
                           scale=scale, block_q=min(block, total),
-                          block_k=min(block, total))
+                          block_k=min(block, total),
+                          dropout_rate=dropout_rate,
+                          dropout_seed=dropout_seed)
     return out[0].transpose(1, 0, 2)          # [total, h, d]
 
 
@@ -46,6 +52,14 @@ class FMHAFun:
 
     @staticmethod
     def apply(qkv, cu_seqlens, p_dropout=0.0, max_s=None, is_training=True,
-              zero_tensors=False):
-        del p_dropout, max_s, is_training, zero_tensors
-        return fmha_varlen(qkv, cu_seqlens)
+              zero_tensors=False, dropout_seed=None):
+        del max_s, zero_tensors
+        rate = float(p_dropout) if is_training else 0.0
+        if rate > 0.0 and dropout_seed is None:
+            # the reference draws from the global philox stream per call;
+            # the stateless TPU kernel needs an explicit per-step seed
+            raise ValueError(
+                "p_dropout > 0 requires dropout_seed (pass a fresh int32 "
+                "per training step)")
+        return fmha_varlen(qkv, cu_seqlens, dropout_rate=rate,
+                           dropout_seed=dropout_seed if rate > 0.0 else None)

@@ -8,14 +8,15 @@ include_norm_add, separate_qkv_params, impl='fast'|'default')``; the
 ``csrc/multihead_attn/self_multihead_attn_*.cu``), 'default' composes
 torch ops.
 
-TPU: 'fast' routes scores through the Pallas flash-attention kernel;
-'default' uses the unfused reference composition (useful for numerics
-checks, like the reference's impl switch). ``include_norm_add`` fuses
-layernorm before QKV and adds the residual after the projection
-(the ``norm_add`` CUDA variants). Probability dropout is applied in the
-'default' path exactly as the reference; the 'fast' path applies it to
-the attention output (documented delta — in-kernel PRNG dropout lands
-with the Pallas dropout epilogue).
+TPU: 'fast' routes through the Pallas flash-attention kernel — including
+under ``key_padding_mask`` (expressed as segment ids) and additive
+``attn_mask`` (the kernel's bias operand), with probability dropout
+applied *inside* the kernel (counter-based hash mask, regenerated — not
+stored — in the backward), matching the reference's softmax-dropout
+placement; 'default' uses the unfused reference
+composition (useful for numerics checks, like the reference's impl
+switch). ``include_norm_add`` fuses layernorm before QKV and adds the
+residual after the projection (the ``norm_add`` CUDA variants).
 
 Layout: inputs are [seq, batch, embed] like the reference modules.
 """
@@ -83,13 +84,32 @@ class SelfMultiheadAttn(nn.Module):
         qh, kh, vh = to_bhsd(q), to_bhsd(k), to_bhsd(v)
         scale = d ** -0.5
 
-        causal = attn_mask == "causal"
-        if self.impl == "fast" and key_padding_mask is None and (
-                attn_mask is None or causal):
-            ctx = flash_attention(qh, kh, vh, causal=bool(causal), scale=scale)
-            if self.dropout > 0 and not deterministic:
-                ctx = nn.Dropout(self.dropout, deterministic=False)(
-                    ctx, rng=self.make_rng("dropout"))
+        causal = isinstance(attn_mask, str) and attn_mask == "causal"
+        if self.impl == "fast":
+            sid_q = sid_kv = None
+            if key_padding_mask is not None:
+                # [b, sk] True = pad -> padding segment id (-1)
+                sid_kv = jnp.where(key_padding_mask, -1, 0).astype(jnp.int32)
+                sid_q = jnp.zeros((b, s), jnp.int32)
+            bias = None
+            if attn_mask is not None and not causal:
+                bias = jnp.asarray(attn_mask)
+                if bias.ndim == 2:          # [sq, sk], the reference layout
+                    bias = bias[None, None]
+                elif bias.ndim != 4:
+                    raise ValueError(
+                        "attn_mask must be [sq, sk] (reference layout) or "
+                        f"an explicit [b|1, h|1, sq, sk]; got {bias.shape} "
+                        "— 3-D masks are ambiguous (per-batch vs per-head)")
+            drop = self.dropout if (self.dropout > 0 and not deterministic) else 0.0
+            seed = None
+            if drop > 0.0:
+                seed = jax.random.randint(
+                    self.make_rng("dropout"), (), 0, 2 ** 31 - 1, jnp.int32)
+            ctx = flash_attention(qh, kh, vh, segment_ids_q=sid_q,
+                                  segment_ids_kv=sid_kv, causal=bool(causal),
+                                  scale=scale, bias=bias, dropout_rate=drop,
+                                  dropout_seed=seed)
         else:
             scores = jnp.einsum("bhqd,bhkd->bhqk", qh.astype(jnp.float32),
                                 kh.astype(jnp.float32)) * scale
@@ -115,9 +135,12 @@ class SelfMultiheadAttn(nn.Module):
         if self.use_bias:
             ob = self.param("out_proj_bias", nn.initializers.zeros, (e,), self.param_dtype)
             out = out + ob.astype(out.dtype)
-        if self.dropout > 0 and not deterministic:
-            out = nn.Dropout(self.dropout, deterministic=False)(
-                out, rng=self.make_rng("dropout"))
         if self.include_norm_add:
+            # dropout-add epilogue (reference jit_dropout_add,
+            # self_multihead_attn.py:19-21,165) — output dropout exists
+            # only in the norm_add variant
+            if self.dropout > 0 and not deterministic:
+                out = nn.Dropout(self.dropout, deterministic=False)(
+                    out, rng=self.make_rng("dropout"))
             out = out + residual
         return out

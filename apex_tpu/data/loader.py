@@ -101,10 +101,19 @@ def transform_batch(images: np.ndarray, indices: np.ndarray, out_h: int,
     if images.dtype != np.uint8 or images.ndim != 4:
         raise ValueError("images must be uint8 [N, H, W, C]")
     n = len(indices)
-    _, sh, sw, c = images.shape
+    n_src, sh, sw, c = images.shape
     if c > 8:
         raise ValueError("at most 8 channels")
+    if out_h > sh or out_w > sw:
+        # the native path would compute a negative crop range and read out
+        # of bounds; fail identically on both paths
+        raise ValueError(
+            f"crop ({out_h}, {out_w}) exceeds source dims ({sh}, {sw})")
     indices = np.ascontiguousarray(indices, np.int64)
+    if n and (indices.min() < 0 or indices.max() >= n_src):
+        raise ValueError(
+            f"indices out of range [0, {n_src}): "
+            f"[{indices.min()}, {indices.max()}]")
     mean32 = np.ascontiguousarray(mean, np.float32)
     std32 = np.ascontiguousarray(std, np.float32)
     out = np.empty((n, out_h, out_w, c), _BF16_VIEW if out_bf16 else np.float32)
@@ -176,6 +185,13 @@ class DataLoader:
         self.batch_size = batch_size
         n, sh, sw, c = self.images.shape
         self.crop = crop or (sh, sw)
+        if self.crop[0] > sh or self.crop[1] > sw:
+            raise ValueError(
+                f"crop {self.crop} exceeds source dims ({sh}, {sw})")
+        if drop_last and n < batch_size:
+            raise ValueError(
+                f"drop_last=True with {n} images < batch_size={batch_size} "
+                "yields zero batches")
         self.mean, self.std = tuple(mean[:c]), tuple(std[:c])
         self.out_bf16 = out_bf16
         self.augment = augment
@@ -204,6 +220,8 @@ class DataLoader:
                    for i in range(len(self))]
         if not self.drop_last and len(idx) % self.batch_size:
             pass  # len() already included the ragged tail
+        if not batches:
+            return
         lib = _native.lib()
         if lib is not None:
             yield from self._iter_native(lib, batches)

@@ -6,10 +6,14 @@ mpu tests, ``apex/transformer/tensor_parallel/tests/``). Built from
 apex_tpu TP layers so the same module runs at tp=1 (plain dense) and
 tp=k inside ``shard_map`` — and under GSPMD with sharding constraints.
 
-TPU notes: attention scores/softmax run through FusedScaleMaskSoftmax
-(fp32 accumulation), matmuls carry ``preferred_element_type=float32`` so
-bf16 weights still accumulate in fp32 on the MXU, and activation
-checkpointing is a flag away (``remat_blocks``).
+TPU notes: attention runs through the Pallas flash-attention kernel
+(``attention_impl="flash"``, the default; ``"fused_softmax"`` keeps the
+FusedScaleMaskSoftmax composition as the numerics-debug path, mirroring
+the reference's ``impl='fast'|'default'`` switch in
+``apex/contrib/multihead_attn/self_multihead_attn.py:26``), matmuls carry
+``preferred_element_type=float32`` so bf16 weights still accumulate in
+fp32 on the MXU, and activation checkpointing is a flag away
+(``remat_blocks``).
 """
 
 from __future__ import annotations
@@ -22,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.ops.flash_attention import flash_attention
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.enums import AttnMaskType
 from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
@@ -40,6 +45,7 @@ class GPTConfig:
     ffn_hidden_size: Optional[int] = None   # default 4*hidden
     dtype: Any = jnp.bfloat16
     remat_blocks: bool = False
+    attention_impl: str = "flash"           # "flash" | "fused_softmax"
 
     @property
     def ffn(self):
@@ -64,16 +70,28 @@ class ParallelSelfAttention(nn.Module):
         qkv = qkv.reshape(b, s, heads_per, 3 * head_dim)
         q, k, v = jnp.split(qkv, 3, axis=-1)      # [b, s, hp, d]
 
-        scores = jnp.einsum("bshd,bthd->bhst", q, k,
-                            preferred_element_type=jnp.float32)
-        softmax = FusedScaleMaskSoftmax(
-            input_in_bf16=cfg.dtype == jnp.bfloat16,
-            attn_mask_type=AttnMaskType.causal,
-            scale=head_dim ** -0.5,
-        )
-        probs = softmax(scores.astype(cfg.dtype))
-        ctx = jnp.einsum("bhst,bthd->bshd", probs.astype(cfg.dtype), v,
-                         preferred_element_type=jnp.float32).astype(cfg.dtype)
+        if cfg.attention_impl not in ("flash", "fused_softmax"):
+            raise ValueError(
+                f"attention_impl must be 'flash' or 'fused_softmax', got "
+                f"{cfg.attention_impl!r}")
+        if cfg.attention_impl == "flash":
+            qh = q.transpose(0, 2, 1, 3)          # [b, hp, s, d]
+            kh = k.transpose(0, 2, 1, 3)
+            vh = v.transpose(0, 2, 1, 3)
+            ctx = flash_attention(qh, kh, vh, causal=True,
+                                  scale=head_dim ** -0.5)
+            ctx = ctx.transpose(0, 2, 1, 3)       # [b, s, hp, d]
+        else:  # "fused_softmax": the unfused numerics-debug path
+            scores = jnp.einsum("bshd,bthd->bhst", q, k,
+                                preferred_element_type=jnp.float32)
+            softmax = FusedScaleMaskSoftmax(
+                input_in_bf16=cfg.dtype == jnp.bfloat16,
+                attn_mask_type=AttnMaskType.causal,
+                scale=head_dim ** -0.5,
+            )
+            probs = softmax(scores.astype(cfg.dtype))
+            ctx = jnp.einsum("bhst,bthd->bshd", probs.astype(cfg.dtype), v,
+                             preferred_element_type=jnp.float32).astype(cfg.dtype)
         ctx = ctx.reshape(b, s, heads_per * head_dim)
         return RowParallelLinear(
             input_size=h, output_size=h, input_is_parallel=True,

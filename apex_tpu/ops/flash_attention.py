@@ -1,19 +1,29 @@
-"""Flash attention: Pallas TPU kernel + chunked-recompute backward.
+"""Flash attention: Pallas TPU kernels, forward AND backward, with
+in-kernel dropout and additive bias.
 
 Reference targets (SURVEY §2.2):
-- ``fmhalib`` (``apex/contrib/csrc/fmha/fmha_api.cpp``): fused MHA for
-  packed variable-length sequences (cu_seqlens), seqlen ≤ 512, sm80 only;
+- ``fmhalib`` (``apex/contrib/csrc/fmha/fmha_api.cpp:67-110`` fwd with
+  p_dropout plumbing, ``:232-319`` bwd): fused MHA for packed
+  variable-length sequences (cu_seqlens), seqlen ≤ 512, sm80 only;
 - ``fast_multihead_attn`` (``apex/contrib/csrc/multihead_attn/*``): fused
-  QKV GEMM + batched score GEMM + softmax + dropout + out-projection.
+  QKV GEMM + batched score GEMM + softmax + dropout + out-projection,
+  incl. additive-mask variants.
 
-TPU design: one flash-attention kernel with online softmax covers both —
-no seqlen cap, with **segment ids** replacing cu_seqlens for packed varlen
-batches (equal-length padding-free packing, the TPU-friendly layout) and
-causal masking for decoder use. The forward is a Pallas kernel tiled for
-the MXU (q blocks resident in VMEM, k/v streamed through the innermost
-grid dimension with online (m, l, acc) accumulation in VMEM scratch);
-the backward recomputes attention blockwise (flash-style O(s) memory)
-with plain XLA ops — dq/dk/dv each from one scan over blocks.
+TPU design: one flash-attention kernel family with online softmax covers
+both — no seqlen cap, with **segment ids** replacing cu_seqlens for packed
+varlen batches (equal-length padding-free packing, the TPU-friendly
+layout), causal masking for decoder use, an optional **additive bias**
+(broadcastable [b|1, h|1, sq, sk] — the additive attn-mask of the fast MHA
+variants), and **in-kernel dropout** driven by a counter-based hash RNG
+(murmur3 finalizer over (seed, b, h, q_pos, k_pos) — see
+``_keep_from_positions``), mask regenerated identically in the backward so
+no dropout mask is ever materialized in HBM.
+
+Memory: the backward is two Pallas kernels (dk/dv with k-blocks outer and
+dq with q-blocks outer), each recomputing p = exp(s - lse) blockwise from
+the saved (q, k, v, out, lse) — O(s) residual memory, O(s^2) flops, the
+flash-attention-2 decomposition. No [sq, sk] matrix is ever materialized
+outside VMEM scratch.
 
 Shapes: q [b, h, sq, d]; k, v [b, h, sk, d]; segment_ids int32 [b, sq]
 ([b, sk] for kv if lengths differ). fp32 accumulation throughout.
@@ -62,18 +72,103 @@ def mha_reference(q, k, v, *, causal=False, segment_ids_q=None,
 
 
 # ---------------------------------------------------------------------------
+# Shared in-kernel helpers
+# ---------------------------------------------------------------------------
+
+def _block_mask(qi, kb, block_q, block_k, causal, causal_offset,
+                sq_ref, skv_ref):
+    """[block_q, block_k] validity mask for block (qi, kb)."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = jnp.ones((block_q, block_k), jnp.bool_)
+    if causal:
+        # offset aligns the (original, pre-padding) sequence ends
+        mask &= k_pos <= q_pos + causal_offset
+    if sq_ref is not None:
+        sid_q = sq_ref[0]                             # [block_q, 1]
+        sid_k = skv_ref[0]                            # [1, block_k]
+        # negative ids are padding: they match nothing, not even each other
+        mask &= (sid_q == sid_k) & (sid_q >= 0)
+    return mask
+
+
+def _fmix32(h):
+    """murmur3 finalizer: full-avalanche 32-bit mix (public constants)."""
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def _keep_from_positions(seed, bi, hi, q_pos, k_pos, dropout_rate):
+    """Counter-based dropout keep mask from *global* positions.
+
+    A pure integer hash of (seed, batch, head, q_pos, k_pos) — no PRNG
+    state, so forward and both backward kernels regenerate the identical
+    mask without ever storing it (the reference stores philox offsets for
+    the same purpose, ``apex/contrib/csrc/fmha/fmha_api.cpp:101``), the
+    mask is independent of block-size choices, and the scheme runs
+    identically on TPU hardware, in interpret mode, and in plain XLA
+    (which is how the tests verify exact parity).
+    """
+    base = _fmix32(jnp.uint32(seed)
+                   ^ (jnp.uint32(bi) * jnp.uint32(0x9E3779B1))
+                   ^ (jnp.uint32(hi) * jnp.uint32(0xB5297A4D)))
+    h = (q_pos.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+         ^ k_pos.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+         ^ base)
+    bits = _fmix32(h)
+    threshold = jnp.uint32(min(int(dropout_rate * 4294967296.0), 4294967295))
+    return bits >= threshold
+
+
+def dropout_keep_reference(seed, b, h, sq, sk, dropout_rate):
+    """[b, h, sq, sk] keep mask exactly as the kernels generate it —
+    test/debug helper (pure XLA)."""
+    q_pos = jnp.arange(sq, dtype=jnp.int32)[:, None]
+    k_pos = jnp.arange(sk, dtype=jnp.int32)[None, :]
+    masks = jnp.stack([
+        jnp.stack([_keep_from_positions(seed, bi, hi, q_pos, k_pos,
+                                        dropout_rate)
+                   for hi in range(h)])
+        for bi in range(b)])
+    return masks
+
+
+def _dropout_keep(seed_ref, bi, hi, qi, kb, block_q, block_k, dropout_rate):
+    """In-kernel keep mask for block (qi, kb) of grid cell (bi, hi)."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return _keep_from_positions(seed_ref[0], bi, hi, q_pos, k_pos,
+                                dropout_rate)
+
+
+def _causal_block_live(qi, kb, block_q, block_k, causal_offset):
+    """Whether block (qi, kb) has any unmasked position under causal."""
+    return kb * block_k <= qi * block_q + (block_q - 1) + causal_offset
+
+
+# ---------------------------------------------------------------------------
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(*refs, scale, causal, block_q, block_k, use_segments,
-                causal_offset):
-    if use_segments:
-        sq_ref, skv_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, \
-            m_scr, l_scr, acc_scr = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
-        sq_ref = skv_ref = None
-    kb = pl.program_id(3)
+                use_bias, dropout_rate, causal_offset):
+    it = iter(refs)
+    sq_ref = next(it) if use_segments else None
+    skv_ref = next(it) if use_segments else None
+    bias_ref = next(it) if use_bias else None
+    seed_ref = next(it) if dropout_rate > 0.0 else None
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = it
+
+    bi, hi, qi, kb = (pl.program_id(0), pl.program_id(1),
+                      pl.program_id(2), pl.program_id(3))
     n_kb = pl.num_programs(3)
 
     @pl.when(kb == 0)
@@ -82,38 +177,41 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, use_segments,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0, 0].astype(jnp.float32)              # [block_q, d]
-    k = k_ref[0, 0].astype(jnp.float32)              # [block_k, d]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+    live = (_causal_block_live(qi, kb, block_q, block_k, causal_offset)
+            if causal else True)
 
-    qi = pl.program_id(2)
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-    k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    mask = jnp.ones((block_q, block_k), jnp.bool_)
-    if causal:
-        # offset aligns the (original, pre-padding) sequence ends
-        mask &= k_pos <= q_pos + causal_offset
-    if use_segments:
-        sid_q = sq_ref[0]                             # [block_q, 1]
-        sid_k = skv_ref[0]                            # [1, block_k]
-        # negative ids are padding: they match nothing, not even each other
-        mask &= (sid_q == sid_k) & (sid_q >= 0)
-    s = jnp.where(mask, s, _NEG_INF)
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)              # [block_q, d]
+        k = k_ref[0, 0].astype(jnp.float32)              # [block_k, d]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if use_bias:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
 
-    m_prev = m_scr[:]                                 # [block_q, 1]
-    m_cur = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    # guard fully-masked rows (padding): keep exp at 0
-    p = jnp.exp(s - m_new)
-    p = jnp.where(mask, p, 0.0)
-    alpha = jnp.exp(m_prev - m_new)
-    l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-        p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_scr[:] = m_new
-    l_scr[:] = l_new
+        mask = _block_mask(qi, kb, block_q, block_k, causal, causal_offset,
+                           sq_ref, skv_ref)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:]                                 # [block_q, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # guard fully-masked rows (padding): keep exp at 0
+        p = jnp.exp(s - m_new)
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref, bi, hi, qi, kb, block_q, block_k,
+                                 dropout_rate)
+            # dropout applies to the normalized p; l (the normalizer) uses
+            # the undropped sum, so scale only the accumulated numerator
+            p = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
 
     @pl.when(kb == n_kb - 1)
     def _finish():
@@ -123,15 +221,11 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, use_segments,
         lse_ref[0, 0] = m_scr[:] + jnp.log(safe_l)    # [block_q, 1]
 
 
-def _flash_fwd(q, k, v, segment_ids_q, segment_ids_kv, scale, causal,
-               block_q, block_k, interpret):
-    b, h, sq, d = q.shape
+def _pad_operands(q, k, v, segment_ids_q, segment_ids_kv, bias, do,
+                  block_q, block_k):
+    """Pad seq dims to block multiples; padded positions get segment id -1."""
+    b, _, sq, _ = q.shape
     sk = k.shape[2]
-    causal_offset = sk - sq   # aligns the original sequence ends
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    # Arbitrary lengths: pad seq dims up to block multiples; padded
-    # positions get segment id -1, which the kernel masks out entirely.
     pad_q = -sq % block_q
     pad_k = -sk % block_k
     if pad_q or pad_k:
@@ -147,14 +241,59 @@ def _flash_fwd(q, k, v, segment_ids_q, segment_ids_kv, scale, causal,
                                 constant_values=-1)
         segment_ids_kv = jnp.pad(segment_ids_kv, ((0, 0), (0, pad_k)),
                                  constant_values=-1)
+        if bias is not None:
+            bias = jnp.pad(bias, ((0, 0), (0, 0), (0, pad_q), (0, pad_k)))
+        if do is not None:
+            do = jnp.pad(do, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    elif segment_ids_q is not None and segment_ids_kv is None:
+        segment_ids_kv = segment_ids_q
+    return q, k, v, segment_ids_q, segment_ids_kv, bias, do, pad_q, pad_k
+
+
+def _seg_specs(block_q, block_k, qdim, kdim):
+    """BlockSpecs for the [b, sq, 1] / [b, 1, sk] segment-id layouts.
+
+    ``qdim``/``kdim``: which grid dim indexes q-blocks / k-blocks.
+    """
+    def qmap(*g):
+        return (g[0], g[qdim], 0)
+
+    def kmap(*g):
+        return (g[0], 0, g[kdim])
+
+    return [pl.BlockSpec((1, block_q, 1), qmap),
+            pl.BlockSpec((1, 1, block_k), kmap)]
+
+
+def _bias_spec(bias, block_q, block_k, qdim, kdim):
+    bb, bh = bias.shape[0], bias.shape[1]
+
+    def bmap(*g):
+        return (g[0] if bb > 1 else 0, g[1] if bh > 1 else 0,
+                g[qdim], g[kdim])
+
+    return pl.BlockSpec((1, 1, block_q, block_k), bmap)
+
+
+def _flash_fwd_impl(q, k, v, segment_ids_q, segment_ids_kv, bias, seed,
+                    scale, causal, dropout_rate, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    causal_offset = sk - sq   # aligns the original sequence ends
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    (q, k, v, segment_ids_q, segment_ids_kv, bias, _, pad_q, pad_k
+     ) = _pad_operands(q, k, v, segment_ids_q, segment_ids_kv, bias, None,
+                       block_q, block_k)
     sq_p, sk_p = sq + pad_q, sk + pad_k
     use_segments = segment_ids_q is not None
+    use_bias = bias is not None
 
     grid = (b, h, sq_p // block_q, sk_p // block_k)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, use_segments=use_segments,
-        causal_offset=causal_offset)
+        block_k=block_k, use_segments=use_segments, use_bias=use_bias,
+        dropout_rate=dropout_rate, causal_offset=causal_offset)
 
     # Mosaic requires the last two block dims to be (8k, 128k) or equal to
     # the array dims — trailing-singleton layouts (b, sq, 1) / (b, 1, sk)
@@ -162,13 +301,14 @@ def _flash_fwd(q, k, v, segment_ids_q, segment_ids_kv, scale, causal,
     in_specs = []
     operands = []
     if use_segments:
-        if segment_ids_kv is None:
-            segment_ids_kv = segment_ids_q
-        in_specs += [
-            pl.BlockSpec((1, block_q, 1), lambda b_, h_, qi, ki: (b_, qi, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda b_, h_, qi, ki: (b_, 0, ki)),
-        ]
+        in_specs += _seg_specs(block_q, block_k, qdim=2, kdim=3)
         operands += [segment_ids_q[:, :, None], segment_ids_kv[:, None, :]]
+    if use_bias:
+        in_specs += [_bias_spec(bias, block_q, block_k, qdim=2, kdim=3)]
+        operands += [bias]
+    if dropout_rate > 0.0:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.SMEM)]
+        operands += [seed]
     in_specs += [
         pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
         pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0)),
@@ -199,13 +339,222 @@ def _flash_fwd(q, k, v, segment_ids_q, segment_ids_kv, scale, causal,
 
 
 # ---------------------------------------------------------------------------
-# Backward: blockwise recompute with XLA (flash-style memory, O(s^2) flops)
+# Pallas backward kernels (flash-attention-2 decomposition)
 # ---------------------------------------------------------------------------
 
-def _bwd_math(res, do, *, scale, causal):
-    q, k, v, out, lse, sid_q, sid_kv = res
+def _recompute_p(q_ref, k_ref, lse_ref, bias_ref, mask, scale):
+    """p = exp(s - lse), zeroed where masked. [block_q, block_k]."""
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0, 0].astype(jnp.float32)
+    s = jnp.where(mask, s, _NEG_INF)
+    return jnp.where(mask, jnp.exp(s - lse_ref[0, 0]), 0.0)
+
+
+def _dkdv_kernel(*refs, scale, causal, block_q, block_k, use_segments,
+                 use_bias, dropout_rate, causal_offset):
+    it = iter(refs)
+    sq_ref = next(it) if use_segments else None
+    skv_ref = next(it) if use_segments else None
+    bias_ref = next(it) if use_bias else None
+    seed_ref = next(it) if dropout_rate > 0.0 else None
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+     dk_ref, dv_ref, dk_scr, dv_scr) = it
+
+    bi, hi, kb, qi = (pl.program_id(0), pl.program_id(1),
+                      pl.program_id(2), pl.program_id(3))
+    n_qb = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    live = (_causal_block_live(qi, kb, block_q, block_k, causal_offset)
+            if causal else True)
+
+    @pl.when(live)
+    def _compute():
+        mask = _block_mask(qi, kb, block_q, block_k, causal, causal_offset,
+                           sq_ref, skv_ref)
+        p = _recompute_p(q_ref, k_ref, lse_ref, bias_ref, mask, scale)
+        do = do_ref[0, 0].astype(jnp.float32)             # [block_q, d]
+        # dp = do @ v^T : [block_q, block_k]
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref, bi, hi, qi, kb, block_q, block_k,
+                                 dropout_rate)
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_drop = jnp.where(keep, p, 0.0) * inv
+            dp = jnp.where(keep, dp, 0.0) * inv
+        else:
+            p_drop = p
+        # dv += p_drop^T @ do : [block_k, d]
+        dv_scr[:] += jax.lax.dot_general(
+            p_drop, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0]) * scale           # [block_q, block_k]
+        # dk += ds^T @ q : [block_k, d]
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q_ref[0, 0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_qb - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _dq_kernel(*refs, scale, causal, block_q, block_k, use_segments,
+               use_bias, dropout_rate, causal_offset):
+    it = iter(refs)
+    sq_ref = next(it) if use_segments else None
+    skv_ref = next(it) if use_segments else None
+    bias_ref = next(it) if use_bias else None
+    seed_ref = next(it) if dropout_rate > 0.0 else None
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr = it
+
+    bi, hi, qi, kb = (pl.program_id(0), pl.program_id(1),
+                      pl.program_id(2), pl.program_id(3))
+    n_kb = pl.num_programs(3)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = (_causal_block_live(qi, kb, block_q, block_k, causal_offset)
+            if causal else True)
+
+    @pl.when(live)
+    def _compute():
+        mask = _block_mask(qi, kb, block_q, block_k, causal, causal_offset,
+                           sq_ref, skv_ref)
+        p = _recompute_p(q_ref, k_ref, lse_ref, bias_ref, mask, scale)
+        do = do_ref[0, 0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref, bi, hi, qi, kb, block_q, block_k,
+                                 dropout_rate)
+            dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout_rate))
+        ds = p * (dp - delta_ref[0, 0]) * scale
+        # dq += ds @ k : [block_q, d]
+        dq_scr[:] += jax.lax.dot_general(
+            ds, k_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == n_kb - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_impl(res, do, *, scale, causal, dropout_rate, block_q,
+                    block_k, interpret):
+    q, k, v, out, lse, sid_q, sid_kv, bias, seed = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    causal_offset = sk - sq
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+
+    # delta = rowsum(do * o) — the softmax-Jacobian contraction term
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)              # [b, h, sq, 1]
+    lse4 = lse[..., None]                                # [b, h, sq, 1]
+
+    (q_p, k_p, v_p, sid_q, sid_kv, bias, do_p, pad_q, pad_k
+     ) = _pad_operands(q, k, v, sid_q, sid_kv, bias, do, block_q, block_k)
+    if pad_q:
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+        lse4 = jnp.pad(lse4, ((0, 0), (0, 0), (0, pad_q), (0, 0)))
+    sq_p, sk_p = sq + pad_q, sk + pad_k
+    use_segments = sid_q is not None
+    use_bias = bias is not None
+    n_qb, n_kb = sq_p // block_q, sk_p // block_k
+    interp = interpret
+
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, use_segments=use_segments,
+                  use_bias=use_bias, dropout_rate=dropout_rate,
+                  causal_offset=causal_offset)
+
+    def extra(qdim, kdim):
+        specs, ops = [], []
+        if use_segments:
+            specs += _seg_specs(block_q, block_k, qdim=qdim, kdim=kdim)
+            ops += [sid_q[:, :, None], sid_kv[:, None, :]]
+        if use_bias:
+            specs += [_bias_spec(bias, block_q, block_k, qdim=qdim, kdim=kdim)]
+            ops += [bias]
+        if dropout_rate > 0.0:
+            specs += [pl.BlockSpec(memory_space=pltpu.SMEM)]
+            ops += [seed]
+        return specs, ops
+
+    def qspec(qdim):
+        return pl.BlockSpec((1, 1, block_q, d),
+                            lambda *g, _q=qdim: (g[0], g[1], g[_q], 0))
+
+    def kspec(kdim):
+        return pl.BlockSpec((1, 1, block_k, d),
+                            lambda *g, _k=kdim: (g[0], g[1], g[_k], 0))
+
+    def rowspec(qdim):
+        return pl.BlockSpec((1, 1, block_q, 1),
+                            lambda *g, _q=qdim: (g[0], g[1], g[_q], 0))
+
+    # --- dk/dv: grid (b, h, kb, qi), k-block resident, q streamed
+    especs, eops = extra(qdim=3, kdim=2)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkdv_kernel, **common),
+        grid=(b, h, n_kb, n_qb),
+        in_specs=especs + [qspec(3), kspec(2), kspec(2), qspec(3),
+                           rowspec(3), rowspec(3)],
+        out_specs=[kspec(2), kspec(2)],
+        out_shape=[jax.ShapeDtypeStruct((b, h, sk_p, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, sk_p, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interp,
+    )(*eops, q_p, k_p, v_p, do_p, lse4, delta)
+
+    # --- dq: grid (b, h, qi, kb), q-block resident, k streamed
+    especs, eops = extra(qdim=2, kdim=3)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **common),
+        grid=(b, h, n_qb, n_kb),
+        in_specs=especs + [qspec(2), kspec(3), kspec(3), qspec(2),
+                           rowspec(2), rowspec(2)],
+        out_specs=qspec(2),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interp,
+    )(*eops, q_p, k_p, v_p, do_p, lse4, delta)
+
+    return dq[:, :, :sq], dk[:, :, :sk], dv[:, :, :sk]
+
+
+# ---------------------------------------------------------------------------
+# Reference backward math (parity baseline for the Pallas kernels; O(s^2)
+# memory — debug/test only)
+# ---------------------------------------------------------------------------
+
+def _bwd_math(res, do, *, scale, causal, dropout_rate=0.0):
+    q, k, v, out, lse, sid_q, sid_kv, bias, seed = res
+    if dropout_rate > 0.0:
+        raise NotImplementedError(
+            "_bwd_math is the no-dropout parity baseline; dropout backward "
+            "runs only in the Pallas kernels")
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
     sq, sk = s.shape[-2], s.shape[-1]
     mask = jnp.ones(s.shape[-2:], jnp.bool_)
     if causal:
@@ -218,7 +567,8 @@ def _bwd_math(res, do, *, scale, causal):
         mask = mask & seg
     # exact softmax via saved lse; explicit zero where masked (a fully
     # masked padding row has lse == _NEG_INF, so exp(s - lse) would be 1)
-    p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)
+    p = jnp.where(mask, jnp.exp(jnp.where(mask, s, _NEG_INF) - lse[..., None]),
+                  0.0)
     do32 = do.astype(jnp.float32)
     dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
     dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v.astype(jnp.float32))
@@ -233,21 +583,12 @@ def _bwd_math(res, do, *, scale, causal):
 # Public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
-def flash_attention(q, k, v, segment_ids_q=None, segment_ids_kv=None,
-                    causal: bool = False, scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
-                    interpret: Optional[bool] = None):
-    """Fused attention. Returns [b, h, sq, d].
-
-    ``segment_ids_*``: packed-varlen support (FMHA cu_seqlens analog) —
-    tokens attend only within equal *non-negative* segment ids; negative
-    ids are padding: they match nothing (not even each other), attend
-    nothing, and produce zero output rows. Sequence lengths need not be
-    multiples of the block sizes (inputs are padded internally).
-    """
-    out, _ = _fa_fwd(q, k, v, segment_ids_q, segment_ids_kv, causal, scale,
-                     block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12))
+def _flash_attention(q, k, v, segment_ids_q, segment_ids_kv, bias, seed,
+                     causal, scale, dropout_rate, block_q, block_k,
+                     interpret):
+    out, _ = _fa_fwd(q, k, v, segment_ids_q, segment_ids_kv, bias, seed,
+                     causal, scale, dropout_rate, block_q, block_k, interpret)
     return out
 
 
@@ -257,17 +598,74 @@ def _resolve_interpret(interpret):
     return jax.default_backend() != "tpu"
 
 
-def _fa_fwd(q, k, v, sid_q, sid_kv, causal, scale, block_q, block_k, interpret):
+def _fa_fwd(q, k, v, sid_q, sid_kv, bias, seed, causal, scale, dropout_rate,
+            block_q, block_k, interpret):
     scale_v = q.shape[-1] ** -0.5 if scale is None else scale
-    out, lse = _flash_fwd(q, k, v, sid_q, sid_kv, scale_v, causal,
-                          block_q, block_k, _resolve_interpret(interpret))
-    return out, (q, k, v, out, lse, sid_q, sid_kv)
+    out, lse = _flash_fwd_impl(q, k, v, sid_q, sid_kv, bias, seed, scale_v,
+                               causal, dropout_rate, block_q, block_k,
+                               _resolve_interpret(interpret))
+    return out, (q, k, v, out, lse, sid_q, sid_kv, bias, seed)
 
 
-def _fa_bwd(causal, scale, block_q, block_k, interpret, res, do):
-    scale_v = res[0].shape[-1] ** -0.5 if scale is None else scale
-    dq, dk, dv = _bwd_math(res, do, scale=scale_v, causal=causal)
-    return dq, dk, dv, None, None
+def _fa_bwd(causal, scale, dropout_rate, block_q, block_k, interpret,
+            res, do):
+    q = res[0]
+    bias = res[7]
+    scale_v = q.shape[-1] ** -0.5 if scale is None else scale
+    dq, dk, dv = _flash_bwd_impl(
+        res, do, scale=scale_v, causal=causal, dropout_rate=dropout_rate,
+        block_q=block_q, block_k=block_k,
+        interpret=_resolve_interpret(interpret))
+    # bias is an additive attention mask — non-differentiable by contract
+    # (matches apex, where masks are inputs, never parameters); a real dbias
+    # would require materializing [sq, sk] and is deliberately not offered.
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    dseed = None
+    return dq, dk, dv, None, None, dbias, dseed
 
 
-flash_attention.defvjp(_fa_fwd, _fa_bwd)
+_flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+def flash_attention(q, k, v, segment_ids_q=None, segment_ids_kv=None,
+                    causal: bool = False, scale: Optional[float] = None,
+                    bias=None, dropout_rate: float = 0.0,
+                    dropout_seed=None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused attention. Returns [b, h, sq, d].
+
+    ``segment_ids_*``: packed-varlen support (FMHA cu_seqlens analog) —
+    tokens attend only within equal *non-negative* segment ids; negative
+    ids are padding: they match nothing (not even each other), attend
+    nothing, and produce zero output rows. Sequence lengths need not be
+    multiples of the block sizes (inputs are padded internally).
+
+    ``bias``: additive attention bias, broadcastable ``[b|1, h|1, sq, sk]``
+    (the additive attn-mask of the fast-MHA variants). Non-differentiable.
+
+    ``dropout_rate``/``dropout_seed``: in-kernel attention dropout via a
+    counter-based hash RNG; the mask is regenerated (never stored) in the
+    backward. ``dropout_seed`` is an int32 scalar (python int or array);
+    pass a fresh value per training step. Ignored when
+    ``dropout_rate == 0``.
+    """
+    if dropout_rate >= 1.0 or dropout_rate < 0.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got {dropout_rate}")
+    if dropout_rate > 0.0:
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+        seed = jnp.asarray(dropout_seed, jnp.int32).reshape((1,))
+    else:
+        seed = jnp.zeros((1,), jnp.int32)
+    if bias is not None:
+        b, h, sq, sk = q.shape[0], q.shape[1], q.shape[2], k.shape[2]
+        if (bias.ndim != 4 or bias.shape[0] not in (1, b)
+                or bias.shape[1] not in (1, h)
+                or bias.shape[2] != sq or bias.shape[3] != sk):
+            raise ValueError(
+                f"bias must broadcast to [{b}, {h}, {sq}, {sk}], got "
+                f"{bias.shape}")
+    return _flash_attention(q, k, v, segment_ids_q, segment_ids_kv, bias,
+                            seed, causal, scale, float(dropout_rate),
+                            block_q, block_k, interpret)

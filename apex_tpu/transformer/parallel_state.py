@@ -156,6 +156,23 @@ def _axis_rank(name: str):
         return 0
 
 
+def psum_if_bound(x, axis_name: str):
+    """``lax.psum`` when ``axis_name`` is bound (inside ``shard_map``),
+    identity otherwise — outside shard_map arrays carry *global* values, so
+    the unreduced value is already the full reduction (tp=1 / GSPMD use)."""
+    try:
+        return jax.lax.psum(x, axis_name)
+    except NameError:
+        return x
+
+
+def pmax_if_bound(x, axis_name: str):
+    try:
+        return jax.lax.pmax(x, axis_name)
+    except NameError:
+        return x
+
+
 def get_tensor_model_parallel_rank():
     """Inside shard_map: traced index on the tensor axis
     (``parallel_state.py:252-258`` analog). Outside: 0."""

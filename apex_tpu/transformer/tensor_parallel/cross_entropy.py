@@ -40,7 +40,7 @@ def _vce_core(logits, target, axis_name):
 
     # 1) global max for stability (cross_entropy.py:28-33)
     lmax = jnp.max(logits, axis=-1)
-    lmax = jax.lax.pmax(lmax, axis_name)
+    lmax = ps.pmax_if_bound(lmax, axis_name)
     shifted = logits.astype(jnp.float32) - lmax[..., None].astype(jnp.float32)
 
     # 2) predicted (target) logit: local-range gather + allreduce (:35-57)
@@ -49,11 +49,11 @@ def _vce_core(logits, target, axis_name):
     local_t = jnp.where(in_range, local_t, 0)
     pred = jnp.take_along_axis(shifted, local_t[..., None], axis=-1)[..., 0]
     pred = jnp.where(in_range, pred, 0.0)
-    pred = jax.lax.psum(pred, axis_name)
+    pred = ps.psum_if_bound(pred, axis_name)
 
     # 3) sum-exp allreduce (:59-69)
     exp = jnp.exp(shifted)
-    sum_exp = jax.lax.psum(jnp.sum(exp, axis=-1), axis_name)
+    sum_exp = ps.psum_if_bound(jnp.sum(exp, axis=-1), axis_name)
 
     loss = jnp.log(sum_exp) - pred
     softmax = exp / sum_exp[..., None]
@@ -67,7 +67,7 @@ def _vce_fwd(logits, target, label_smoothing, axis_name):
         # computed from the saved softmax shard
         vocab = softmax.shape[-1] * ps._axis_size(axis_name)
         logp = jnp.log(jnp.maximum(softmax, 1e-30))
-        mean_logp = jax.lax.psum(jnp.sum(logp, axis=-1), axis_name) / vocab
+        mean_logp = ps.psum_if_bound(jnp.sum(logp, axis=-1), axis_name) / vocab
         loss = (1.0 - label_smoothing) * loss - label_smoothing * mean_logp
     return loss, (softmax, in_range, local_t)
 

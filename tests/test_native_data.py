@@ -140,3 +140,32 @@ def test_dataloader_python_fallback_parity(monkeypatch):
     for (xn, yn), (xp, yp) in zip(native, fallback):
         np.testing.assert_array_equal(yn, yp)
         np.testing.assert_allclose(xn, xp, rtol=1e-6, atol=1e-6)
+
+
+def test_transform_batch_validates_bounds():
+    """ADVICE r1: oversize crops / out-of-range indices must raise on both
+    the native and numpy paths (the C ABI would read out of bounds)."""
+    images = np.zeros((4, 8, 8, 3), np.uint8)
+    idx = np.arange(2)
+    with pytest.raises(ValueError, match="crop"):
+        transform_batch(images, idx, 16, 8, (0.5,) * 3, (0.2,) * 3)
+    with pytest.raises(ValueError, match="crop"):
+        transform_batch(images, idx, 8, 9, (0.5,) * 3, (0.2,) * 3)
+    with pytest.raises(ValueError, match="indices"):
+        transform_batch(images, np.array([0, 4]), 4, 4, (0.5,) * 3, (0.2,) * 3)
+    with pytest.raises(ValueError, match="indices"):
+        transform_batch(images, np.array([-1]), 4, 4, (0.5,) * 3, (0.2,) * 3)
+
+
+def test_dataloader_validates_crop_and_small_dataset():
+    images = np.zeros((3, 8, 8, 3), np.uint8)
+    labels = np.zeros(3, np.int64)
+    with pytest.raises(ValueError, match="crop"):
+        DataLoader(images, labels, batch_size=2, crop=(9, 8))
+    with pytest.raises(ValueError, match="zero batches"):
+        DataLoader(images, labels, batch_size=8, drop_last=True)
+    # drop_last=False with a small dataset yields the ragged batch
+    dl = DataLoader(images, labels, batch_size=8, drop_last=False,
+                    augment=False, shuffle=False)
+    batches = list(dl)
+    assert len(batches) == 1 and len(batches[0][0]) == 3
