@@ -7,8 +7,14 @@ from apex_tpu.amp.frontend import (  # noqa: F401
     initialize,
     state_dict,
     load_state_dict,
+    master_state_dict,
+    load_master_state_dict,
     make_train_step,
     AmpModel,
+)
+from apex_tpu.amp.lists import (  # noqa: F401
+    register_half_module,
+    register_float_module,
 )
 from apex_tpu.amp.handle import scale_loss, disable_casts, AmpHandle, NoOpHandle  # noqa: F401
 from apex_tpu.amp.policy import (  # noqa: F401

@@ -22,10 +22,12 @@ from __future__ import annotations
 
 from typing import Any, Callable, Sequence
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
 from apex_tpu.amp import policy as _policy_mod
+from apex_tpu.amp.lists import o1_interceptor
 from apex_tpu.amp import scaler as _scaler_mod
 from apex_tpu.amp._amp_state import _amp_state, maybe_print, warn_or_err
 from apex_tpu.amp.properties import Properties, opt_levels
@@ -77,7 +79,11 @@ class AmpModel:
             args = cast_floating(args, p.cast_model_type)
             kwargs = cast_floating(kwargs, p.cast_model_type)
         if p.cast_ops:
-            with _policy_mod.autocast(True, p.half_dtype):
+            # the autocast policy drives the apex_tpu op registry; the flax
+            # interceptor gives default O1 coverage to arbitrary flax
+            # modules (the reference's cast-lists, apex/amp/amp.py:68-177)
+            with _policy_mod.autocast(True, p.half_dtype), \
+                    nn.intercept_methods(o1_interceptor):
                 out = self.apply_fn(params, *args, **kwargs)
         else:
             out = self.apply_fn(params, *args, **kwargs)
@@ -196,6 +202,19 @@ def initialize(
 # (apex/amp/frontend.py:361-400 — serializes every loss scaler's scale and
 # unskipped count)
 # ---------------------------------------------------------------------------
+
+def master_state_dict(optimizer, opt_state, params=None):
+    """fp32 model checkpoint under O2 (``O2StateDictHook`` analog,
+    ``apex/amp/_initialize.py:133-142``): always returns fp32 parameters,
+    read from the optimizer's master buffer when present."""
+    return optimizer.master_params(opt_state, params)
+
+
+def load_master_state_dict(optimizer, opt_state, fp32_params):
+    """Restore an fp32 checkpoint: ``(model_params, opt_state)`` with
+    params recast to their run dtypes and the master replaced bitwise."""
+    return optimizer.restore_master(opt_state, fp32_params)
+
 
 def state_dict() -> dict:
     d = {}

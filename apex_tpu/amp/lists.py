@@ -1,0 +1,120 @@
+"""O1 default cast coverage for arbitrary flax models.
+
+Reference: apex O1 monkey-patches ~200 torch-namespace functions through
+curated cast lists (``apex/amp/lists/functional_overrides.py:17-80``
+FP16_FUNCS/FP32_FUNCS, ``torch_overrides.py:7-115``,
+``tensor_overrides.py:12-63``), so *any* model gets per-op mixed precision
+with no model changes. JAX has no mutable op namespace, but flax has the
+equivalent seam: ``nn.intercept_methods`` sees every module call of an
+``apply``. The table below maps module *classes* (the flax analog of the
+reference's function lists) to a cast action:
+
+- ``half``: matmul-class modules (Dense/Conv/Einsum/attention — the
+  FP16_FUNCS row: conv1-3d, linear, matmul, bmm, mm, …) run with compute
+  dtype = the policy half dtype. Parameters keep fp32 *storage*
+  (``param_dtype`` untouched — O1 master weights); flax's ``promote_dtype``
+  casts them per-op at trace time, which XLA CSEs, exactly the reference's
+  weight-cast cache (``apex/amp/utils.py:97-158``) for free.
+- ``float``: normalization / reduction-sensitive modules (the FP32_FUNCS
+  row: *norm, softmax, pow, sum, …) run with compute dtype fp32.
+
+Anything not listed runs untouched (the MATCH_INPUT / promote default —
+elementwise ops follow their input dtypes, which is what the reference's
+casts_after promotion achieves).
+
+The interceptor overrides the module's ``dtype`` field for the duration of
+the call (flax modules are per-call bound clones, so the mutation is
+trace-local) and also casts floating *array* arguments, so chains of
+listed modules don't bounce through fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Literal, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import policy as _policy_mod
+
+Action = Literal["half", "float"]
+
+
+def _collect(names):
+    out = []
+    for n in names:
+        cls = getattr(nn, n, None)
+        if isinstance(cls, type):
+            out.append(cls)
+    return out
+
+
+# FP16_FUNCS analog (functional_overrides.py:17-42: conv*, linear, matmul…)
+_HALF_MODULES: list[type] = _collect([
+    "Dense", "DenseGeneral", "Einsum",
+    "Conv", "ConvTranspose", "ConvLocal",
+    "MultiHeadDotProductAttention", "MultiHeadAttention", "SelfAttention",
+])
+
+# FP32_FUNCS analog (functional_overrides.py:44-62: *norm, softmax, …)
+_FLOAT_MODULES: list[type] = _collect([
+    "BatchNorm", "LayerNorm", "GroupNorm", "RMSNorm", "InstanceNorm",
+    "SpectralNorm", "WeightNorm",
+])
+
+
+def register_half_module(cls: type) -> None:
+    """Add a flax module class to the O1 half list
+    (``apex.amp.register_half_function`` analog for modules)."""
+    if cls not in _HALF_MODULES:
+        _HALF_MODULES.append(cls)
+
+
+def register_float_module(cls: type) -> None:
+    if cls not in _FLOAT_MODULES:
+        _FLOAT_MODULES.append(cls)
+
+
+def module_cast_action(mod: Any) -> Optional[Action]:
+    # exact-class and subclass matches; FLOAT wins on diamond ancestry
+    # (safety first, mirroring the reference's banned/FP32 priority)
+    for cls in _FLOAT_MODULES:
+        if isinstance(mod, cls):
+            return "float"
+    for cls in _HALF_MODULES:
+        if isinstance(mod, cls):
+            return "half"
+    return None
+
+
+def _cast_float_arrays(tree, dtype):
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x, tree)
+
+
+def o1_interceptor(next_fun: Callable, args, kwargs, context):
+    """``nn.intercept_methods`` interceptor applying the cast table."""
+    p = _policy_mod.current_policy()
+    if p is None or not p.enabled or context.method_name != "__call__":
+        return next_fun(*args, **kwargs)
+    mod = context.module
+    action = module_cast_action(mod)
+    if action is None:
+        return next_fun(*args, **kwargs)
+    target = p.half_dtype if action == "half" else jnp.float32
+    args = _cast_float_arrays(args, target)
+    kwargs = _cast_float_arrays(kwargs, target)
+    has_dtype = hasattr(mod, "dtype")
+    if not has_dtype:
+        return next_fun(*args, **kwargs)
+    prev = mod.dtype
+    # flax modules are frozen dataclasses; the bound clone is private to
+    # this call, so a scoped override of the *compute* dtype is safe
+    object.__setattr__(mod, "dtype", target)
+    try:
+        return next_fun(*args, **kwargs)
+    finally:
+        object.__setattr__(mod, "dtype", prev)

@@ -175,7 +175,8 @@ class DataLoader:
                  out_bf16: bool = False, augment: bool = True,
                  shuffle: bool = True, drop_last: bool = True,
                  seed: int = 0, prefetch: int = 4, workers: int = 2,
-                 inner_threads: int = 4):
+                 inner_threads: int = 4,
+                 shard_id: int = 0, num_shards: int = 1):
         if images.dtype != np.uint8 or images.ndim != 4:
             raise ValueError("images must be uint8 [N, H, W, C]")
         if len(images) != len(labels):
@@ -188,10 +189,10 @@ class DataLoader:
         if self.crop[0] > sh or self.crop[1] > sw:
             raise ValueError(
                 f"crop {self.crop} exceeds source dims ({sh}, {sw})")
-        if drop_last and n < batch_size:
+        if drop_last and n // max(1, num_shards) < batch_size:
             raise ValueError(
-                f"drop_last=True with {n} images < batch_size={batch_size} "
-                "yields zero batches")
+                f"drop_last=True with {n} images / {num_shards} shard(s) < "
+                f"batch_size={batch_size} yields zero batches")
         self.mean, self.std = tuple(mean[:c]), tuple(std[:c])
         self.out_bf16 = out_bf16
         self.augment = augment
@@ -201,17 +202,34 @@ class DataLoader:
         self.prefetch = max(1, prefetch)
         self.workers = max(1, workers)
         self.inner_threads = max(1, inner_threads)
+        # multi-host: every host holds (or mmaps) the dataset and iterates
+        # a disjoint stripe — pass shard_id=jax.process_index(),
+        # num_shards=jax.process_count(); the per-epoch shuffle is
+        # seed-synchronized so stripes stay disjoint across hosts
+        if not (0 <= shard_id < num_shards):
+            raise ValueError(f"shard_id {shard_id} not in [0, {num_shards})")
+        self.shard_id = shard_id
+        self.num_shards = num_shards
         self._epoch = 0
 
     def __len__(self) -> int:
-        n = len(self.images)
+        n = self._shard_len()
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def _shard_len(self) -> int:
+        # every shard is truncated to the same length so all hosts run the
+        # same number of batches per epoch — unequal shards would deadlock
+        # lockstep collectives (torch DistributedSampler equalizes too)
+        return len(self.images) // self.num_shards
 
     def _epoch_indices(self) -> np.ndarray:
         idx = np.arange(len(self.images), dtype=np.int64)
         if self.shuffle:
             np.random.RandomState((self.seed + self._epoch) & 0x7fffffff).shuffle(idx)
-        return idx
+        # strided split of the SAME shuffled order on every host: shards
+        # are disjoint; the tail remainder (< num_shards items) is dropped
+        # to keep every host's epoch the same length
+        return idx[self.shard_id::self.num_shards][:self._shard_len()]
 
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         self._epoch += 1

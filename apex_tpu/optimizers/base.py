@@ -154,6 +154,52 @@ class FusedOptimizerBase:
         out_params = new_params[0] if single else new_params
         return out_params, OptimizerState(groups=tuple(new_groups))
 
+    # -- checkpoint fidelity (O2StateDictHook analog) ----------------------
+    def master_params(self, state: OptimizerState, params=None):
+        """fp32 view of the model parameters for checkpointing.
+
+        The reference installs ``O2StateDictHook`` so model ``state_dict``s
+        are always saved fp32 (``apex/amp/_initialize.py:133-142,208-210``);
+        here the fp32 master lives in the optimizer state, so the fp32
+        checkpoint is read from ``state.groups[i].master``. Without master
+        weights the live ``params`` (cast up) are the truth — pass them.
+        """
+        outs = []
+        for spec, gstate, p in zip(
+                self._specs, state.groups,
+                ([params] if len(self.param_groups) == 1 else
+                 (params or [None] * len(self.param_groups)))):
+            if gstate.master is not None:
+                outs.append(spec.unpack(
+                    gstate.master.astype(jnp.float32), dtype_from_spec=False))
+            elif p is not None:
+                outs.append(jax.tree.map(
+                    lambda x: x.astype(jnp.float32)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x, p))
+            else:
+                raise ValueError(
+                    "no master weights in state; pass the live params")
+        return outs[0] if len(self.param_groups) == 1 else outs
+
+    def restore_master(self, state: OptimizerState, fp32_params):
+        """Load an fp32 checkpoint: returns ``(model_params, new_state)``.
+
+        Model params come back in their original (possibly half) dtypes;
+        the optimizer master (if any) is replaced bitwise, so resuming
+        continues exactly (round-trip contract of the reference's
+        recommended save/restore recipe, ``README.md:57-99``).
+        """
+        single = len(self.param_groups) == 1
+        plist = [fp32_params] if single else list(fp32_params)
+        new_params, new_groups = [], []
+        for spec, gstate, p in zip(self._specs, state.groups, plist):
+            flat = spec.pack(p, dtype=self.master_dtype)
+            master = flat if gstate.master is not None else None
+            new_groups.append(GroupState(gstate.step, master, gstate.slots))
+            new_params.append(spec.unpack(flat))
+        out = new_params[0] if single else new_params
+        return out, OptimizerState(groups=tuple(new_groups))
+
     # -- amp hooks ---------------------------------------------------------
     def configure_amp(self, properties, scaler):
         """Called by ``amp.initialize`` (frontend.py): adopt master-weight

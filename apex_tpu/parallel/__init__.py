@@ -16,6 +16,7 @@ from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
     create_syncbn_process_group,
 )
 from apex_tpu.optimizers.larc import LARC  # noqa: F401
+from apex_tpu.parallel.multiproc import init_distributed  # noqa: F401
 
 
 class ReduceOp:

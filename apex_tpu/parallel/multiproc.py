@@ -19,6 +19,37 @@ import subprocess
 import sys
 
 
+def init_distributed(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None):
+    """Connect this process to the JAX distributed runtime.
+
+    Reads the env contract this launcher sets (``JAX_COORDINATOR_ADDRESS``,
+    ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``) unless given explicitly —
+    the multi-host analog of the reference's ``--rank``/``--world-size``
+    plumbing into ``torch.distributed.init_process_group``
+    (``apex/parallel/multiproc.py:12-35``). On real TPU pods the args are
+    auto-detected and this reduces to ``jax.distributed.initialize()``.
+
+    After this, ``jax.devices()`` spans all hosts;
+    ``parallel_state.initialize_model_parallel`` then builds the global
+    mesh with the data axis outermost, so DP crosses hosts (DCN) while
+    tp/pp/cp ride intra-host ICI.
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     world_size = None

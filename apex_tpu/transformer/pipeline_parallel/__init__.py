@@ -16,7 +16,9 @@ from apex_tpu.transformer.pipeline_parallel.p2p import (  # noqa: F401
 )
 from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
     pipeline_apply,
+    pipeline_apply_interleaved,
     forward_backward_no_pipelining,
     forward_backward_pipelining_without_interleaving,
+    forward_backward_pipelining_with_interleaving,
     get_forward_backward_func,
 )

@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.transformer import parallel_state as ps
-from apex_tpu.transformer.pipeline_parallel.p2p import send_forward_recv_forward
+from apex_tpu.transformer.pipeline_parallel.p2p import (
+    ring_shift, send_forward_recv_forward)
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x,
@@ -106,13 +107,112 @@ def forward_backward_pipelining_without_interleaving(
     return loss, grads
 
 
+def pipeline_apply_interleaved(stage_fn: Callable, chunk_params, x,
+                               n_microbatches: int, n_chunks: int,
+                               axis_name: str = ps.PIPELINE_AXIS,
+                               remat: bool = True):
+    """Interleaved (virtual-pipeline) schedule over the pipeline axis.
+
+    Each rank holds ``n_chunks`` (= vpp) model chunks stacked on the
+    leading axis of every leaf of ``chunk_params``; chunk ``c`` of rank
+    ``r`` is *global* stage ``c*P + r`` (the Megatron interleaved
+    assignment whose rank state the reference tracks,
+    ``apex/transformer/parallel_state.py:252-322``).
+
+    Schedule: unit (microbatch m, chunk c) runs on rank r at tick
+    ``t = (m//P)*V*P + c*P + (m%P) + r``. Every activation is consumed
+    exactly one tick after it is produced, so one held slot and one
+    ring ``ppermute`` per tick suffice (same transport as the
+    non-interleaved schedule) while each rank time-multiplexes its V
+    chunks. Total ticks = ``V*nmb + P - 1`` — the (P-1)-tick bubble of
+    GPipe's ``V*(nmb + P - 1)`` shrinks by the factor V that interleaving
+    exists to deliver.
+
+    Requires ``n_microbatches % P == 0`` (the Megatron constraint).
+    ``x``: [n_microbatches, mb, ...]; returns [n_microbatches, mb, ...]
+    final-stage outputs (valid on the last rank).
+    """
+    n_stages = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    V = n_chunks
+    lead = {leaf.shape[0] for leaf in jax.tree_util.tree_leaves(chunk_params)}
+    if lead != {V}:
+        raise ValueError(
+            f"chunk_params leaves must be stacked [n_chunks={V}, ...]; got "
+            f"leading dims {sorted(lead)}")
+    if n_microbatches % n_stages != 0:
+        raise ValueError(
+            f"interleaved schedule needs n_microbatches ({n_microbatches}) "
+            f"divisible by pipeline size ({n_stages})")
+    total_ticks = V * n_microbatches + n_stages - 1
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    h_shape = x.shape[1:]
+    init_held = jnp.zeros(h_shape, x.dtype)
+    init_out = jnp.zeros((n_microbatches,) + h_shape, x.dtype)
+
+    def tick(carry, t):
+        held, outputs = carry
+        u = t - rank                      # unit index in this rank's order
+        valid = (u >= 0) & (u < V * n_microbatches)
+        uc = jnp.clip(u, 0, V * n_microbatches - 1)
+        group, rem = uc // (V * n_stages), uc % (V * n_stages)
+        c = rem // n_stages               # chunk to apply this tick
+        m = group * n_stages + rem % n_stages  # microbatch of this unit
+
+        params_c = jax.tree.map(
+            lambda p: jax.lax.dynamic_index_in_dim(p, c, 0, keepdims=False),
+            chunk_params)
+
+        inject = jax.lax.dynamic_index_in_dim(x, m, keepdims=False)
+        use_inject = valid & (c == 0) & (rank == 0)
+        inp = jnp.where(use_inject, inject, held)
+        out = fn(params_c, inp)
+        # collect completed microbatches on the last rank's last chunk
+        done = valid & (c == V - 1) & (rank == n_stages - 1)
+        updated = jax.lax.dynamic_update_index_in_dim(outputs, out, m, 0)
+        outputs = jnp.where(done, updated, outputs)
+        # cyclic: the last rank's chunk-c output wraps to rank 0, which
+        # consumes it next tick as chunk c+1's input
+        held_next = ring_shift(out, axis_name, wrap=True)
+        return (held_next, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(tick, (init_held, init_out),
+                                   jnp.arange(total_ticks))
+    return outputs
+
+
+def forward_backward_pipelining_with_interleaving(
+        stage_fn: Callable, loss_head: Callable, chunk_params, x,
+        n_microbatches: int, n_chunks: Optional[int] = None,
+        axis_name: str = ps.PIPELINE_AXIS):
+    """Interleaved pipeline + loss, returning (loss, chunk-param grads)."""
+    n_stages = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    if n_chunks is None:
+        n_chunks = ps.get_virtual_pipeline_model_parallel_world_size() or 1
+        if n_chunks == 1:
+            leaf = jax.tree_util.tree_leaves(chunk_params)[0]
+            n_chunks = leaf.shape[0]
+
+    def full(params):
+        outs = pipeline_apply_interleaved(stage_fn, params, x,
+                                          n_microbatches, n_chunks,
+                                          axis_name)
+        loss = loss_head(outs)
+        return jnp.where(rank == n_stages - 1, loss, 0.0)
+
+    loss, grads = jax.value_and_grad(full)(chunk_params)
+    return loss, grads
+
+
 def get_forward_backward_func(virtual_pipeline_model_parallel_size=None,
                               pipeline_model_parallel_size: int = 1):
-    """Dispatch mirroring Megatron's ``get_forward_backward_func``."""
+    """Dispatch mirroring Megatron's ``get_forward_backward_func``
+    (vpp state: ``apex/transformer/parallel_state.py:252-322``)."""
     if pipeline_model_parallel_size > 1:
-        if virtual_pipeline_model_parallel_size is not None:
-            raise NotImplementedError(
-                "interleaved (virtual pipeline) schedule is not implemented "
-                "yet; use the non-interleaved schedule")
+        if (virtual_pipeline_model_parallel_size is not None
+                and virtual_pipeline_model_parallel_size > 1):
+            return forward_backward_pipelining_with_interleaving
         return forward_backward_pipelining_without_interleaving
     return forward_backward_no_pipelining

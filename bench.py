@@ -186,6 +186,11 @@ def main():
         o2_ips, o2_dt, o2_flops = _time_steps("O2", want_flops=True)
         o0_ips, _, _ = _time_steps("O0")
         extras = {}
+        try:
+            o1_ips, _, _ = _time_steps("O1")
+            extras["o1_speedup_vs_o0"] = round(o1_ips / o0_ips, 3)
+        except Exception as e:
+            extras["o1_error"] = f"{type(e).__name__}: {e}"[:120]
         peak = _peak_flops()
         if o2_flops and peak:
             extras["mfu"] = round(o2_flops / o2_dt / peak, 4)

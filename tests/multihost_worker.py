@@ -1,0 +1,116 @@
+"""Worker for the 2-process multi-host CPU test (run via multiproc).
+
+Each process simulates one host with 4 virtual CPU devices; together they
+form a 2x4 mesh (dp=2 across "hosts"/DCN, tp=4 intra-host/ICI — the
+DCN-outermost ordering ``initialize_model_parallel`` guarantees). One amp
+train step runs with per-host data sharding; every process prints
+``MULTIHOST_OK rank=<r> loss=<x>`` on success.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4").strip()
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import shard_map  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+def main():
+    from apex_tpu.parallel import init_distributed
+    init_distributed()
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    rank = jax.process_index()
+
+    from apex_tpu.data import DataLoader
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer import parallel_state as ps
+    from apex_tpu.transformer.tensor_parallel import (
+        ColumnParallelLinear, RowParallelLinear)
+
+    ps.destroy_model_parallel()
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size_=4)
+    assert ps.get_data_parallel_world_size() == 2
+    # DCN-outermost: the data axis must split across processes — every
+    # device column of one dp row lives on one process
+    dp_rows = mesh.devices  # [dp=2, pp=1, tp=4]
+    for i in range(2):
+        procs = {d.process_index for d in dp_rows[i].flatten()}
+        assert procs == {i}, (i, procs)
+
+    # per-host input pipeline: disjoint stripes of one dataset
+    rng = np.random.RandomState(0)
+    images = (rng.rand(32, 8, 8, 3) * 255).astype(np.uint8)
+    labels = rng.randint(0, 4, 32).astype(np.int64)
+    loader = DataLoader(images, labels, batch_size=8, augment=False,
+                        shuffle=True, seed=7, workers=1,
+                        shard_id=rank, num_shards=2)
+    x_local, y_local = next(iter(loader))
+    x_local = np.asarray(x_local, np.float32).reshape(8, -1)
+
+    # global batch 16 = 2 hosts x 8; dp shards the batch across hosts
+    mlp_in, hidden, nclass = x_local.shape[-1], 32, 4
+
+    col = ColumnParallelLinear(input_size=mlp_in, output_size=hidden,
+                               gather_output=False)
+    row = RowParallelLinear(input_size=hidden, output_size=nclass,
+                            input_is_parallel=True)
+    opt = FusedAdam(lr=1e-2)
+
+    # host-local arrays -> one global dp-sharded array
+    xg = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(ps.DATA_AXIS)), x_local, (16, mlp_in))
+    yg = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(ps.DATA_AXIS)), y_local.astype(np.int32), (16,))
+
+    def step(x, y):
+        # init inside shard_map: TP layers create their local weight
+        # shard on each rank (rank-aware init, the Megatron pattern)
+        k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+        params = {
+            "col": col.init({"params": k1}, jnp.zeros((1, mlp_in)))["params"],
+            "row": row.init({"params": k2},
+                            jnp.zeros((1, hidden // 4)))["params"],
+        }
+        opt_state = opt.init(params)
+
+        def loss_fn(p):
+            h = jax.nn.relu(col.apply({"params": p["col"]}, x))
+            logits = row.apply({"params": p["row"]}, h)
+            onehot = jax.nn.one_hot(y, nclass)
+            return -jnp.mean(jnp.sum(
+                jax.nn.log_softmax(logits) * onehot, -1))
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = jax.lax.pmean(grads, ps.DATA_AXIS)
+        loss = jax.lax.pmean(loss, ps.DATA_AXIS)
+        new_params, _ = opt.apply(opt_state, params, grads)
+        del new_params
+        return loss
+
+    f = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(ps.DATA_AXIS), P(ps.DATA_AXIS)),
+        out_specs=P(), check_vma=False)
+    loss = jax.jit(f)(xg, yg)
+    loss = float(loss)
+    assert np.isfinite(loss), loss
+    print(f"MULTIHOST_OK rank={rank} loss={loss:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)
