@@ -173,6 +173,16 @@ def pmax_if_bound(x, axis_name: str):
         return x
 
 
+def axis_size_if_bound(axis_name) -> int:
+    """Size of ``axis_name`` inside shard_map, 1 when unbound/None."""
+    if axis_name is None:
+        return 1
+    try:
+        return jax.lax.axis_size(axis_name)
+    except NameError:
+        return 1
+
+
 def get_tensor_model_parallel_rank():
     """Inside shard_map: traced index on the tensor axis
     (``parallel_state.py:252-258`` analog). Outside: 0."""
