@@ -20,7 +20,7 @@ def cu_seqlens_to_segment_ids(cu_seqlens, total: int):
 
 
 def fmha_varlen(qkv, cu_seqlens, *, causal: bool = False,
-                scale: float | None = None, block: int = 128,
+                scale: float | None = None, block: int = 512,
                 dropout_rate: float = 0.0, dropout_seed=None):
     """qkv: [total, 3, h, d] packed batch. Returns [total, h, d].
 

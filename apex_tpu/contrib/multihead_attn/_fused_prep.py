@@ -1,0 +1,39 @@
+"""Shared fast-path prep for the fused multihead-attention variants:
+masks/dropout arguments → flash-attention kernel operands."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def prep_fast_path(key_padding_mask, attn_mask, b, sq, dropout,
+                   deterministic, make_rng, *, causal=False):
+    """Returns (sid_q, sid_kv, bias, dropout_rate, dropout_seed).
+
+    - ``key_padding_mask`` [b, sk] True=pad → kv segment ids (-1 = pad);
+    - additive ``attn_mask`` → kernel bias, [sq, sk] (reference layout)
+      or explicit [b|1, h|1, sq, sk] (3-D is ambiguous per-batch vs
+      per-head and rejected);
+    - dropout seed drawn from the module's 'dropout' RNG stream.
+    """
+    sid_q = sid_kv = None
+    if key_padding_mask is not None:
+        sid_kv = jnp.where(key_padding_mask, -1, 0).astype(jnp.int32)
+        sid_q = jnp.zeros((b, sq), jnp.int32)
+    bias = None
+    if attn_mask is not None and not causal:
+        bias = jnp.asarray(attn_mask)
+        if bias.ndim == 2:              # [sq, sk], the reference layout
+            bias = bias[None, None]
+        elif bias.ndim != 4:
+            raise ValueError(
+                "attn_mask must be [sq, sk] (reference layout) or an "
+                f"explicit [b|1, h|1, sq, sk]; got {bias.shape} — 3-D "
+                "masks are ambiguous (per-batch vs per-head)")
+    drop = dropout if (dropout > 0 and not deterministic) else 0.0
+    seed = None
+    if drop > 0.0:
+        seed = jax.random.randint(make_rng("dropout"), (), 0, 2 ** 31 - 1,
+                                  jnp.int32)
+    return sid_q, sid_kv, bias, drop, seed

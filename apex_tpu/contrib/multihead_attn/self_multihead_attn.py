@@ -29,6 +29,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.contrib.multihead_attn._fused_prep import prep_fast_path
 from apex_tpu.ops.flash_attention import flash_attention, mha_reference
 from apex_tpu.ops.layer_norm import fused_layer_norm_affine
 
@@ -86,26 +87,9 @@ class SelfMultiheadAttn(nn.Module):
 
         causal = isinstance(attn_mask, str) and attn_mask == "causal"
         if self.impl == "fast":
-            sid_q = sid_kv = None
-            if key_padding_mask is not None:
-                # [b, sk] True = pad -> padding segment id (-1)
-                sid_kv = jnp.where(key_padding_mask, -1, 0).astype(jnp.int32)
-                sid_q = jnp.zeros((b, s), jnp.int32)
-            bias = None
-            if attn_mask is not None and not causal:
-                bias = jnp.asarray(attn_mask)
-                if bias.ndim == 2:          # [sq, sk], the reference layout
-                    bias = bias[None, None]
-                elif bias.ndim != 4:
-                    raise ValueError(
-                        "attn_mask must be [sq, sk] (reference layout) or "
-                        f"an explicit [b|1, h|1, sq, sk]; got {bias.shape} "
-                        "— 3-D masks are ambiguous (per-batch vs per-head)")
-            drop = self.dropout if (self.dropout > 0 and not deterministic) else 0.0
-            seed = None
-            if drop > 0.0:
-                seed = jax.random.randint(
-                    self.make_rng("dropout"), (), 0, 2 ** 31 - 1, jnp.int32)
+            sid_q, sid_kv, bias, drop, seed = prep_fast_path(
+                key_padding_mask, attn_mask, b, s, self.dropout,
+                deterministic, self.make_rng, causal=causal)
             ctx = flash_attention(qh, kh, vh, segment_ids_q=sid_q,
                                   segment_ids_kv=sid_kv, causal=bool(causal),
                                   scale=scale, bias=bias, dropout_rate=drop,

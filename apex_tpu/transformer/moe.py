@@ -87,8 +87,9 @@ def expert_parallel_mlp(x, router_w, wi, wo, *,
     # aux is computed from local tokens; average over the expert group so
     # every rank carries the same load-balancing scalar when x is sharded
     aux = ps.psum_if_bound(aux, axis_name) / ep
+    # dispatch is 0/1 — safe in x.dtype; combine carries the fp32 router
+    # gate and stays fp32 (the switch recipe keeps gating full precision)
     dispatch = dispatch.astype(x.dtype)
-    combine = combine.astype(x.dtype)
 
     # [t, E, C] x [t, h] -> [E, C, h] (tokens grouped by global expert)
     expert_in = jnp.einsum("tec,th->ech", dispatch, x)
