@@ -16,6 +16,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.amp import policy as _policy_mod
+
 
 def _glorot(key, shape):
     fan_in, fan_out = shape[0], shape[-1]
@@ -50,14 +52,25 @@ class _CellBase:
     def init_carry(self, batch):
         return jnp.zeros((batch, self.hidden_size), jnp.float32)
 
+    @staticmethod
+    def _mm(a, w):
+        """O1 RNN special-casing (apex rnn_cast, ``apex/amp/wrap.py:131+``):
+        matmuls run in the autocast half dtype on the MXU; the result is
+        cast back so scan carries keep a stable dtype."""
+        pol = _policy_mod.current_policy()
+        if pol is not None and pol.enabled:
+            dt = pol.half_dtype
+            return (a.astype(dt) @ w.astype(dt)).astype(a.dtype)
+        return a @ w
+
     def _lin(self, p, x, h):
-        z = x @ p["w_ih"] + h @ p["w_hh"]
+        z = self._mm(x, p["w_ih"]) + self._mm(h, p["w_hh"])
         if self.bias:
             z = z + p["b_ih"] + p["b_hh"]
         return z
 
     def _out(self, p, h):
-        return h @ p["w_ho"] if self.output_size is not None else h
+        return self._mm(h, p["w_ho"]) if self.output_size is not None else h
 
 
 class RNNCell(_CellBase):
@@ -92,8 +105,8 @@ class GRUCell(_CellBase):
     gates = 3
 
     def __call__(self, p, h, x):
-        xz = x @ p["w_ih"] + (p["b_ih"] if self.bias else 0.0)
-        hz = h @ p["w_hh"] + (p["b_hh"] if self.bias else 0.0)
+        xz = self._mm(x, p["w_ih"]) + (p["b_ih"] if self.bias else 0.0)
+        hz = self._mm(h, p["w_hh"]) + (p["b_hh"] if self.bias else 0.0)
         xr, xu, xn = jnp.split(xz, 3, axis=-1)
         hr, hu, hn = jnp.split(hz, 3, axis=-1)
         r = jax.nn.sigmoid(xr + hr)
@@ -115,8 +128,8 @@ class mLSTMCell(LSTMCell):
 
     def __call__(self, p, carry, x):
         h, c = carry
-        m = (x @ p["w_mx"]) * (h @ p["w_mh"])
-        z = x @ p["w_ih"] + m @ p["w_hh"]
+        m = self._mm(x, p["w_mx"]) * self._mm(h, p["w_mh"])
+        z = self._mm(x, p["w_ih"]) + self._mm(m, p["w_hh"])
         if self.bias:
             z = z + p["b_ih"] + p["b_hh"]
         i, f, g, o = jnp.split(z, 4, axis=-1)

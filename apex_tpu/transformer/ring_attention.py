@@ -195,6 +195,5 @@ def ulysses_attention(q, k, v, axis_name: str = ps.CONTEXT_AXIS,
 
     from apex_tpu.ops.flash_attention import flash_attention
     qs, ks, vs = to_seq(q), to_seq(k), to_seq(v)
-    out = flash_attention(qs, ks, vs, causal=causal, scale=scale,
-                          block_q=min(128, qs.shape[2]), block_k=min(128, ks.shape[2]))
+    out = flash_attention(qs, ks, vs, causal=causal, scale=scale)
     return to_heads(out)

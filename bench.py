@@ -181,6 +181,43 @@ def _bench_fused_adam():
     return dt_eager / dt_fused, dt_fused, dt_eager
 
 
+def _bench_gpt():
+    """GPT train-step throughput (BASELINE config 5: apex.transformer GPT
+    with the Pallas flash-attention path). Returns (tok/s, mfu|None)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from apex_tpu.models import GPT, GPTConfig
+    from apex_tpu.transformer import parallel_state as ps
+
+    ps.destroy_model_parallel()
+    b, s = 8, 1024
+    cfg = GPTConfig(vocab_size=32768, max_seq_len=s, hidden_size=1024,
+                    num_layers=12, num_heads=16, dtype=jnp.bfloat16)
+    model = GPT(cfg)
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 32768, (b, s)), jnp.int32)
+    labels = jnp.asarray(np.roll(np.asarray(ids), -1, 1))
+    v = model.init(jax.random.PRNGKey(0), ids)
+
+    @jax.jit
+    def step(v, ids, labels):
+        return jax.value_and_grad(lambda v: model.loss(v, ids, labels))(v)
+
+    flops = _step_flops(step, v, ids, labels)
+    loss, grads = step(v, ids, labels)
+    float(loss)
+    n = 10
+    t0 = time.perf_counter()
+    for _ in range(n):
+        loss, grads = step(v, ids, labels)
+    float(loss)
+    dt = (time.perf_counter() - t0) / n
+    peak = _peak_flops()
+    mfu = flops / dt / peak if (flops and peak) else None
+    return b * s / dt, mfu
+
+
 def main():
     try:
         o2_ips, o2_dt, o2_flops = _time_steps("O2", want_flops=True)
@@ -201,6 +238,13 @@ def main():
             extras["eager_adam_ms"] = round(dt_e * 1e3, 3)
         except Exception as e:
             extras["fused_adam_error"] = f"{type(e).__name__}: {e}"[:120]
+        try:
+            gpt_tps, gpt_mfu = _bench_gpt()
+            extras["gpt_tokens_per_sec"] = round(gpt_tps, 1)
+            if gpt_mfu:
+                extras["gpt_mfu"] = round(gpt_mfu, 4)
+        except Exception as e:
+            extras["gpt_error"] = f"{type(e).__name__}: {e}"[:120]
         print(json.dumps({
             "metric": "resnet50_O2_train_throughput",
             "value": round(o2_ips, 2),

@@ -124,3 +124,40 @@ def test_pyprof_cost_analysis_and_annotate():
         _ = f(x)
     wrapped = pyprof.wrap(f, "wrapped_f")
     assert float(wrapped(x)) == float(f(x))
+
+
+def test_rnn_o1_autocast_casts_matmuls():
+    """O1 RNN special-casing (apex rnn_cast): gate matmuls run bf16 under
+    autocast, carries stay fp32 so lax.scan dtypes are stable."""
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.amp import autocast
+    from apex_tpu.rnn.cells import LSTMCell
+
+    cell = LSTMCell(8, 16)
+    p = cell.init_params(jax.random.PRNGKey(0))
+    x = jnp.ones((4, 8), jnp.float32)
+    carry = cell.init_carry(4)
+
+    def run(p, carry, x):
+        with autocast(True, jnp.bfloat16):
+            return cell(p, carry, x)
+
+    dots = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "dot_general":
+                dots.append(tuple(iv.aval.dtype for iv in eqn.invars))
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+    walk(jax.make_jaxpr(run)(p, carry, x).jaxpr)
+    assert dots and all(d == (jnp.bfloat16, jnp.bfloat16) for d in dots)
+
+    (h, c), y = run(p, carry, x)
+    assert h.dtype == jnp.float32 and c.dtype == jnp.float32
+    # numerics still track the fp32 path
+    (h0, c0), _ = cell(p, carry, x)
+    import numpy as np
+    np.testing.assert_allclose(np.asarray(h), np.asarray(h0), atol=2e-2)
