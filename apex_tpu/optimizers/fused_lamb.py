@@ -14,24 +14,11 @@ step stays one fused XLA program.
 
 from __future__ import annotations
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 
 from apex_tpu.optimizers.base import FusedOptimizerBase
-from apex_tpu.utils.flat import FlatBuffer
-
-_SEGMENT_CACHE: dict[tuple, np.ndarray] = {}
-
-
-def segment_ids_for(spec: FlatBuffer) -> jnp.ndarray:
-    key = spec.sizes  # content key: id() could alias a GC'd spec
-    if key not in _SEGMENT_CACHE:
-        ids = np.concatenate([
-            np.full(size, i, dtype=np.int32) for i, size in enumerate(spec.sizes)
-        ]) if spec.sizes else np.zeros(0, np.int32)
-        _SEGMENT_CACHE[key] = ids
-    return jnp.asarray(_SEGMENT_CACHE[key])
+from apex_tpu.utils.flat import leaf_slices
 
 
 class FusedLAMB(FusedOptimizerBase):
@@ -97,17 +84,23 @@ class FusedLAMB(FusedOptimizerBase):
         if wd != 0.0:
             update = update + wd * p
 
-        # Per-tensor trust ratio via segment reductions.
-        seg = segment_ids_for(spec)
-        n = len(spec.sizes)
-        w_sq = jax.ops.segment_sum(p * p, seg, num_segments=n)
-        u_sq = jax.ops.segment_sum(update * update, seg, num_segments=n)
-        w_norm = jnp.sqrt(w_sq)
-        u_norm = jnp.sqrt(u_sq)
+        # Per-tensor trust ratio via STATIC per-leaf slice reductions.
+        # (segment_sum + a flat-sized ratio gather lower to scatter/gather
+        # on TPU and made a BERT-base LAMB step ~100x slower than the
+        # matmuls; per-leaf slices fuse into plain reductions.)
         # NVLAMB skips the trust ratio for tensors excluded from decay when
         # use_nvlamb=False (fused_lamb.py use_nvlamb flag; here wd is
         # per-group so the per-tensor condition reduces to the norms check).
-        ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / jnp.maximum(u_norm, 1e-30), 1.0)
-        if not self.use_nvlamb and wd == 0.0:
-            ratio = jnp.ones_like(ratio)
-        return p - lr * ratio[seg] * update, {"exp_avg": m, "exp_avg_sq": v}
+        use_ratio = self.use_nvlamb or wd != 0.0
+        parts = []
+        for p_i, u_i in zip(leaf_slices(p, spec), leaf_slices(update, spec)):
+            if use_ratio:
+                w_n = jnp.sqrt(jnp.sum(p_i * p_i))
+                u_n = jnp.sqrt(jnp.sum(u_i * u_i))
+                ratio = jnp.where((w_n > 0) & (u_n > 0),
+                                  w_n / jnp.maximum(u_n, 1e-30), 1.0)
+            else:
+                ratio = jnp.asarray(1.0, jnp.float32)
+            parts.append(p_i - lr * ratio * u_i)
+        new_p = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        return new_p, {"exp_avg": m, "exp_avg_sq": v}

@@ -6,9 +6,10 @@ tensor* (EMA of the squared grad norm), the first moment is
 ``m = β1·m + g/√(v)+ε (+ wd·p)``, with options ``reg_inside_moment``,
 ``grad_averaging``, ``norm_type`` (0=inf, 2=L2) and ``init_zero``.
 
-TPU: per-tensor norms via ``segment_sum`` over the flat buffer; moments
-stay flat; the per-tensor scalar v is a small vector indexed back through
-the static segment map.
+TPU: per-tensor norms via STATIC per-leaf slice reductions over the flat
+buffer (segment_sum/gather lower poorly on TPU — see FusedLAMB); moments
+stay flat; the per-tensor scalar v is a small vector expanded back by
+per-leaf scaling.
 """
 
 from __future__ import annotations
@@ -17,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.optimizers.base import FusedOptimizerBase
-from apex_tpu.optimizers.fused_lamb import segment_ids_for
+from apex_tpu.utils.flat import leaf_slices
 
 
 class FusedNovoGrad(FusedOptimizerBase):
@@ -47,12 +48,10 @@ class FusedNovoGrad(FusedOptimizerBase):
             "initialized": jnp.asarray(False),
         }
 
-    def _tensor_norms(self, g, spec):
-        seg = segment_ids_for(spec)
-        n = len(spec.sizes)
+    def _tensor_norms(self, g_parts):
         if self.norm_type == 2:
-            return jnp.sqrt(jax.ops.segment_sum(g * g, seg, num_segments=n))
-        return jax.ops.segment_max(jnp.abs(g), seg, num_segments=n)
+            return jnp.stack([jnp.sqrt(jnp.sum(gi * gi)) for gi in g_parts])
+        return jnp.stack([jnp.max(jnp.abs(gi)) for gi in g_parts])
 
     def _update(self, p, g, slots, step, group, spec):
         lr = jnp.asarray(group["lr"], jnp.float32)
@@ -60,17 +59,18 @@ class FusedNovoGrad(FusedOptimizerBase):
         eps = group["eps"]
         wd = group.get("weight_decay", 0.0)
         grad_averaging = group.get("grad_averaging", True)
-        seg = segment_ids_for(spec)
         m, v, inited = slots["exp_avg"], slots["exp_avg_sq"], slots["initialized"]
 
-        g_norm = self._tensor_norms(g, spec)
+        g_parts = leaf_slices(g, spec)
+        g_norm = self._tensor_norms(g_parts)
         # init_zero=False: first step seeds v with ||g||² (fused_novograd.py:151-158)
         v_seed = jnp.zeros_like(g_norm) if self.init_zero else g_norm * g_norm if self.norm_type == 2 else g_norm
         v_next = jnp.where(inited, beta2 * v + (1.0 - beta2) * (g_norm * g_norm if self.norm_type == 2 else g_norm), v_seed)
         denom_t = jnp.sqrt(v_next) if self.norm_type == 2 else v_next
-        denom = denom_t[seg] + eps
 
-        g_scaled = g / denom
+        g_scaled = jnp.concatenate(
+            [gi / (denom_t[i] + eps) for i, gi in enumerate(g_parts)]
+        ) if len(g_parts) > 1 else g_parts[0] / (denom_t[0] + eps)
         if wd != 0.0 and self.moment_mode == 0:
             g_scaled = g_scaled + wd * p  # reg inside moment
         beta1_eff = (1.0 - beta1) if grad_averaging else 1.0

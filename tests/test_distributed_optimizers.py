@@ -106,3 +106,24 @@ def test_dist_lamb_matches_fused_lamb():
     for k in params:
         np.testing.assert_allclose(np.asarray(out_sharded[k]), np.asarray(cur[k]),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_dist_lamb_small_leaf_norms_exact():
+    """A tiny leaf after a large prefix must get a correct trust ratio —
+    a cumsum-difference implementation cancels to 0.0 in f32 and silently
+    corrupts LAMB dynamics (caught in review, round 2)."""
+    rng = np.random.RandomState(0)
+    params = {
+        "big": jnp.asarray(rng.rand(2_000_000).astype(np.float32)),
+        "tiny": jnp.asarray(rng.rand(256).astype(np.float32) * 0.01),
+    }
+    grads = jax.tree.map(lambda p: jnp.ones_like(p) * 1e-3, params)
+    opt = DistributedFusedLAMB(lr=1e-2, weight_decay=0.05)
+    state = opt.init(params)
+    sums = opt._range_sums(opt._padded(opt._spec.pack(
+        {"big": params["big"] ** 1, "tiny": params["tiny"]}, jnp.float32), 1) ** 2,
+        0, opt._spec.total)
+    expected_tiny = float(jnp.sum(params["tiny"] ** 2))
+    got_tiny = float(sums[1])
+    assert got_tiny > 0
+    np.testing.assert_allclose(got_tiny, expected_tiny, rtol=1e-5)

@@ -235,3 +235,28 @@ def test_master_weights_half_params():
     for _ in range(3):
         cur, state = opt.apply(state, cur, g)
     assert float(state.groups[0].master[0]) < 1.0
+
+
+def test_lamb_hlo_has_no_flat_sized_constant():
+    """The flat→leaf segment map must be generated in-program: a host
+    constant the size of the parameter buffer (~400 MB at 100M params)
+    blew past the remote-compile request limit on hardware."""
+    from apex_tpu.optimizers import FusedLAMB
+    from apex_tpu.utils.flat import flat_segment_ids
+
+    params = {f"w{i}": jnp.zeros((512, 512)) for i in range(8)}  # 2M params
+    grads = jax.tree.map(jnp.ones_like, params)
+    opt = FusedLAMB(lr=1e-3)
+    state = opt.init(params)
+    text = jax.jit(lambda s, p, g: opt.apply(s, p, g)).lower(
+        state, params, grads).as_text()
+    # an embedded 2M-element dense constant would be tens of MB of text
+    assert len(text) < 2_000_000, len(text)
+
+    # the generator matches the straightforward numpy construction
+    sizes = (3, 5, 1)
+    ref = np.concatenate([np.full(s, i) for i, s in enumerate(sizes)])
+    got = np.asarray(flat_segment_ids(sizes, 9))
+    np.testing.assert_array_equal(got, ref)
+    got_pad = np.asarray(flat_segment_ids(sizes, 12, sink_id=3))
+    np.testing.assert_array_equal(got_pad, np.concatenate([ref, [3, 3, 3]]))

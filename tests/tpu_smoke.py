@@ -84,11 +84,20 @@ def main():
                 st, params, grads))
 
     # transducer + groupbn + weight norm
-    from apex_tpu.contrib.transducer import TransducerJoint
-    f = jax.random.normal(key, (2, 16, 64), jnp.float32)
-    g = jax.random.normal(key, (2, 8, 64), jnp.float32)
-    ok &= _check("transducer joint+loss", lambda: jax.jit(lambda f, g: (
-        TransducerJoint()(f, g)))(f, g))
+    from apex_tpu.contrib.transducer import TransducerJoint, TransducerLoss
+    f = jax.random.normal(key, (2, 16, 8), jnp.float32)
+    g = jax.random.normal(key, (2, 6, 8), jnp.float32)
+    labels = jax.random.randint(key, (2, 5), 1, 8)
+    f_len = jnp.asarray([16, 12], jnp.int32)
+    y_len = jnp.asarray([5, 4], jnp.int32)
+
+    def _transducer(f, g, labels, f_len, y_len):
+        joint = TransducerJoint()(f, g)          # [b, T, U, h]
+        return TransducerLoss()(jax.nn.log_softmax(joint, -1), labels,
+                                f_len, y_len)
+
+    ok &= _check("transducer joint+loss", lambda: jax.jit(jax.grad(
+        lambda f: jnp.sum(_transducer(f, g, labels, f_len, y_len))))(f))
 
     from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
     bn = BatchNorm2d_NHWC(num_features=32)
