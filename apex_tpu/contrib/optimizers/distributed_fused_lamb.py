@@ -14,11 +14,12 @@ params.
 
 Per-tensor reductions exploit that each leaf occupies a CONTIGUOUS range
 of the flat buffer, so every leaf∩shard intersection is a contiguous
-(dynamic) range: shard-local per-leaf sums are cumulative-sum
-differences, and the per-position trust ratio is a piecewise-constant
-ramp built by one tiny scatter + cumsum — no ``segment_sum`` scatter and
-no flat-sized gather, both of which lower poorly on TPU (a BERT-base
-LAMB step went ~100x slower than its matmuls through them).
+(dynamic) range: shard-local per-leaf sums are masked static-length
+window reductions (exact — see ``_range_sums``), and the per-position
+trust ratio is a piecewise-constant ramp built by one tiny scatter +
+cumsum — no ``segment_sum`` scatter and no flat-sized gather, both of
+which lower poorly on TPU (a BERT-base LAMB step went ~100x slower than
+its matmuls through them).
 """
 
 from __future__ import annotations
@@ -71,13 +72,11 @@ class DistributedFusedLAMB:
             flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
         return flat
 
-    def _leaf_ranges_in_shard(self, base, per):
-        """Per-leaf [start, end) in shard coordinates (clipped, dynamic)."""
+    def _leaf_starts_in_shard(self, base, per):
+        """Per-leaf clipped start positions in shard coordinates (the
+        piecewise trust-ratio ramp's scatter indices)."""
         offs = jnp.asarray(self._spec.offsets, jnp.int32)
-        sizes = jnp.asarray(self._spec.sizes, jnp.int32)
-        starts = jnp.clip(offs - base, 0, per)
-        ends = jnp.clip(offs + sizes - base, 0, per)
-        return starts, ends
+        return jnp.clip(offs - base, 0, per)
 
     def _range_sums(self, x, base, per):
         """Per-leaf sums of the leaf∩shard ranges, computed EXACTLY.
@@ -146,7 +145,7 @@ class DistributedFusedLAMB:
             rank = 0
 
         base = rank * per if world > 1 else 0
-        starts, ends = self._leaf_ranges_in_shard(base, per)
+        starts = self._leaf_starts_in_shard(base, per)
 
         # global grad norm + clip (distributed_fused_lamb.py:665-699)
         gsq = jnp.sum(g_shard * g_shard)

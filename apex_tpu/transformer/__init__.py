@@ -13,6 +13,7 @@ from apex_tpu.transformer import functional  # noqa: F401
 from apex_tpu.transformer.moe import (  # noqa: F401
     ExpertParallelMLP, expert_parallel_mlp, top1_routing)
 from apex_tpu.transformer.ring_attention import (  # noqa: F401
-    ring_self_attention, ulysses_attention)
+    ring_self_attention, ulysses_attention, zigzag_merge,
+    zigzag_ring_self_attention, zigzag_split)
 
 from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType, ModelType  # noqa: F401

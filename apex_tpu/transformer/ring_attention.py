@@ -197,3 +197,209 @@ def ulysses_attention(q, k, v, axis_name: str = ps.CONTEXT_AXIS,
     qs, ks, vs = to_seq(q), to_seq(k), to_seq(v)
     out = flash_attention(qs, ks, vs, causal=causal, scale=scale)
     return to_heads(out)
+
+
+# ---------------------------------------------------------------------------
+# Zigzag ring attention: load-balanced causal context parallelism
+# ---------------------------------------------------------------------------
+
+def zigzag_split(x, cp: int, axis: int = 2):
+    """Reorder a global sequence into the zigzag layout: the sequence is
+    cut into ``2*cp`` chunks and device r gets chunks ``(r, 2cp-1-r)``
+    concatenated. Returns the reordered GLOBAL array (shard it over the
+    context axis afterwards). Inverse: :func:`zigzag_merge`.
+
+    Why: under plain rank-ordered causal ring attention every ring step
+    has at least one device with live work, so the lockstep ring takes
+    ``cp`` full steps regardless of masking. The zigzag pairing makes
+    every device's causal workload equal (~2 of 4 half-pairs per step),
+    halving causal wall-clock.
+    """
+    s = x.shape[axis]
+    if s % (2 * cp):
+        raise ValueError(f"seq len {s} not divisible by 2*cp={2 * cp}")
+    chunks = jnp.split(x, 2 * cp, axis=axis)
+    out = []
+    for r in range(cp):
+        out += [chunks[r], chunks[2 * cp - 1 - r]]
+    return jnp.concatenate(out, axis=axis)
+
+
+def zigzag_merge(x, cp: int, axis: int = 2):
+    """Inverse of :func:`zigzag_split`."""
+    s = x.shape[axis]
+    if s % (2 * cp):
+        raise ValueError(f"seq len {s} not divisible by 2*cp={2 * cp}")
+    chunks = jnp.split(x, 2 * cp, axis=axis)
+    out = [None] * (2 * cp)
+    for r in range(cp):
+        out[r] = chunks[2 * r]
+        out[2 * cp - 1 - r] = chunks[2 * r + 1]
+    return jnp.concatenate(out, axis=axis)
+
+
+def _zz_halves(t):
+    half = t.shape[2] // 2
+    return t[:, :, :half], t[:, :, half:]
+
+
+def _zz_pair_mask(qc, kc, half, causal_within):
+    """Mask for (q chunk id qc, k chunk id kc) pair; None = full."""
+    del qc, kc
+    if not causal_within:
+        return None
+    i = jnp.arange(half)
+    return (i[None, :] <= i[:, None])[None, None]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def zigzag_ring_self_attention(q, k, v, axis_name: str = ps.CONTEXT_AXIS,
+                               scale: Optional[float] = None):
+    """CAUSAL exact attention over zigzag-ordered context shards.
+
+    q, k, v: [b, h, s_local, d] where the local sequence is the
+    concatenation of global chunks ``(r, 2cp-1-r)`` (see
+    :func:`zigzag_split`). Every device does ~half the block work of the
+    full ring each step — the causal load balance the plain ring cannot
+    achieve. Returns the local output in the same zigzag layout.
+    """
+    out, _ = _zz_fwd(q, k, v, axis_name, scale)
+    return out
+
+
+def _zz_fwd(q, k, v, axis_name, scale):
+    cp = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    half = s_local // 2
+    scale_v = d ** -0.5 if scale is None else scale
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    q0, q1 = _zz_halves(q.astype(jnp.float32))
+    causal_mask = _zz_pair_mask(0, 0, half, True)
+
+    def fold(state, bm, bl, bacc):
+        m, l, acc = state
+        m_new = jnp.maximum(m, bm)
+        a_old = jnp.where(m > _NEG_INF / 2, jnp.exp(m - m_new), 0.0)
+        a_blk = jnp.where(bm > _NEG_INF / 2, jnp.exp(bm - m_new), 0.0)
+        return (m_new, a_old * l + a_blk * bl,
+                a_old[..., None] * acc + a_blk[..., None] * bacc)
+
+    def body(t, carry):
+        k_cur, v_cur, st0, st1 = carry
+        src = jnp.mod(rank - t, cp)
+        k0, k1 = _zz_halves(k_cur.astype(jnp.float32))
+        v0, v1 = _zz_halves(v_cur.astype(jnp.float32))
+        full = jnp.ones((1, 1, half, half), jnp.bool_)
+
+        # pair (q0, k0): chunk ids (rank, src) — live iff src <= rank;
+        # causal-within when equal
+        def q0k0(st0=st0, k0=k0, v0=v0, src=src):
+            mask = jnp.where(src == rank, causal_mask, full)
+            return fold(st0, *_block_attn(q0, k0, v0, scale_v, mask))
+
+        st0 = jax.lax.cond(src <= rank, q0k0, lambda: st0)
+        # pair (q1, k0): q chunk 2cp-1-rank >= cp > src — always full
+        st1 = fold(st1, *_block_attn(q1, k0, v0, scale_v, full))
+        # pair (q1, k1): chunk ids (2cp-1-rank, 2cp-1-src) — live iff
+        # src >= rank; causal-within when equal
+        def q1k1(st1=st1, k1=k1, v1=v1, src=src):
+            mask = jnp.where(src == rank, causal_mask, full)
+            return fold(st1, *_block_attn(q1, k1, v1, scale_v, mask))
+
+        st1 = jax.lax.cond(src >= rank, q1k1, lambda: st1)
+        # pair (q0, k1): k chunk >= cp > q chunk — never live
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, st0, st1)
+
+    def init_state():
+        return (jnp.full((b, h, half), _NEG_INF, jnp.float32),
+                jnp.zeros((b, h, half), jnp.float32),
+                jnp.zeros((b, h, half, d), jnp.float32))
+
+    _, _, (m0, l0, a0), (m1, l1, a1) = jax.lax.fori_loop(
+        0, cp, body, (k, v, init_state(), init_state()))
+    sl0 = jnp.where(l0 > 0, l0, 1.0)
+    sl1 = jnp.where(l1 > 0, l1, 1.0)
+    out = jnp.concatenate([a0 / sl0[..., None], a1 / sl1[..., None]],
+                          axis=2).astype(q.dtype)
+    lse = jnp.concatenate([m0 + jnp.log(sl0), m1 + jnp.log(sl1)], axis=2)
+    return out, (q, k, v, out, lse)
+
+
+def _zz_bwd(axis_name, scale, res, do):
+    q, k, v, out, lse = res
+    cp = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    half = s_local // 2
+    scale_v = d ** -0.5 if scale is None else scale
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    q32 = q.astype(jnp.float32)
+    do32 = do.astype(jnp.float32)
+    delta = jnp.sum(do32 * out.astype(jnp.float32), axis=-1)
+    q0, q1 = _zz_halves(q32)
+    do0, do1 = _zz_halves(do32)
+    lse0, lse1 = lse[:, :, :half], lse[:, :, half:]
+    dl0, dl1 = delta[:, :, :half], delta[:, :, half:]
+    causal_mask = _zz_pair_mask(0, 0, half, True)
+    full = jnp.ones((1, 1, half, half), jnp.bool_)
+
+    def pair_grads(qh, doh, lseh, deltah, kh, vh, mask):
+        """One (q-half, kv-half) pair: (dq_h, dk_h, dv_h) contributions."""
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale_v
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.where(mask, jnp.exp(s - lseh[..., None]), 0.0)
+        dv = jnp.einsum("bhqk,bhqd->bhkd", p, doh)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", doh, vh)
+        ds = p * (dp - deltah[..., None]) * scale_v
+        dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kh)
+        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qh)
+        return dq, dk, dv
+
+    def body(t, carry):
+        k_cur, v_cur, dk_cur, dv_cur, dq = carry
+        src = jnp.mod(rank - t, cp)
+        k0, k1 = _zz_halves(k_cur.astype(jnp.float32))
+        v0, v1 = _zz_halves(v_cur.astype(jnp.float32))
+        dk0, dk1 = _zz_halves(dk_cur)
+        dv0, dv1 = _zz_halves(dv_cur)
+        dq0, dq1 = _zz_halves(dq)
+
+        def p00(dq0=dq0, dk0=dk0, dv0=dv0, k0=k0, v0=v0, src=src):
+            mask = jnp.where(src == rank, causal_mask, full)
+            a, bk, bv = pair_grads(q0, do0, lse0, dl0, k0, v0, mask)
+            return dq0 + a, dk0 + bk, dv0 + bv
+
+        dq0, dk0, dv0 = jax.lax.cond(src <= rank, p00,
+                                     lambda: (dq0, dk0, dv0))
+        a, bk, bv = pair_grads(q1, do1, lse1, dl1, k0, v0, full)
+        dq1, dk0, dv0 = dq1 + a, dk0 + bk, dv0 + bv
+
+        def p11(dq1=dq1, dk1=dk1, dv1=dv1, k1=k1, v1=v1, src=src):
+            mask = jnp.where(src == rank, causal_mask, full)
+            a, bk, bv = pair_grads(q1, do1, lse1, dl1, k1, v1, mask)
+            return dq1 + a, dk1 + bk, dv1 + bv
+
+        dq1, dk1, dv1 = jax.lax.cond(src >= rank, p11,
+                                     lambda: (dq1, dk1, dv1))
+
+        dq = jnp.concatenate([dq0, dq1], axis=2)
+        dk_cur = jnp.concatenate([dk0, dk1], axis=2)
+        dv_cur = jnp.concatenate([dv0, dv1], axis=2)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_nxt = jax.lax.ppermute(dv_cur, axis_name, perm)
+        return (k_nxt, v_nxt, dk_nxt, dv_nxt, dq)
+
+    zeros = jnp.zeros((b, h, s_local, d), jnp.float32)
+    _, _, dk, dv, dq = jax.lax.fori_loop(
+        0, cp, body, (k, v, zeros, zeros, zeros))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+zigzag_ring_self_attention.defvjp(_zz_fwd, _zz_bwd)

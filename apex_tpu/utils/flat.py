@@ -61,26 +61,6 @@ class FlatBuffer:
         return jax.tree.unflatten(self.treedef, leaves)
 
 
-def flat_segment_ids(sizes: Sequence[int], length: int,
-                     sink_id: int | None = None) -> jax.Array:
-    """int32 ``[length]`` map of flat index → leaf index, generated
-    IN-PROGRAM (iota + searchsorted over a tiny static boundaries array).
-
-    Replaces materializing the map as a host constant: for a 100M-param
-    buffer that constant is ~400 MB embedded in the HLO — it blew past
-    the remote-compile request limit and wasted HBM. ``sink_id`` labels
-    positions past ``sum(sizes)`` (alignment padding).
-    """
-    total = int(sum(sizes))
-    boundaries = jnp.asarray(np.cumsum(sizes)[:-1] if len(sizes) > 1
-                             else np.zeros(0), jnp.int32)
-    idx = jnp.arange(length, dtype=jnp.int32)
-    ids = jnp.searchsorted(boundaries, idx, side="right").astype(jnp.int32)
-    if sink_id is not None and length > total:
-        ids = jnp.where(idx >= total, sink_id, ids)
-    return ids
-
-
 def leaf_slices(flat: jax.Array, spec: "FlatBuffer") -> list[jax.Array]:
     """Static per-leaf views of a flat buffer (shared by the fused
     optimizers' per-tensor reductions)."""
