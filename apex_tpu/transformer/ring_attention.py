@@ -48,6 +48,34 @@ def _block_attn(q32, k32, v32, scale, mask):
     return m, l, acc
 
 
+def _fold(state, bm, bl, bacc):
+    """Merge one block's (m, l, acc) into the online-softmax state,
+    guarding exp(-inf - -inf) on never-touched rows."""
+    m, l, acc = state
+    m_new = jnp.maximum(m, bm)
+    a_old = jnp.where(m > _NEG_INF / 2, jnp.exp(m - m_new), 0.0)
+    a_blk = jnp.where(bm > _NEG_INF / 2, jnp.exp(bm - m_new), 0.0)
+    return (m_new, a_old * l + a_blk * bl,
+            a_old[..., None] * acc + a_blk[..., None] * bacc)
+
+
+def _block_grads(qh, doh, lseh, deltah, kh, vh, scale, mask):
+    """One (q-block, kv-block) pair of the flash backward:
+    returns (dq, dk, dv) contributions. ``mask=None`` = full."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jnp.exp(s - lseh[..., None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, doh)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", doh, vh)
+    ds = p * (dp - deltah[..., None]) * scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kh)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qh)
+    return dq, dk, dv
+
+
 def _step_mask(rank, src, s_local, causal):
     """Block mask for (q chunk ``rank``, kv chunk ``src``); None = full."""
     if not causal:
@@ -88,13 +116,7 @@ def _ring_fwd(q, k, v, axis_name, causal, scale):
                 q32, k_cur.astype(jnp.float32), v_cur.astype(jnp.float32),
                 scale_v, jnp.ones((1, 1, s_local, s_local), jnp.bool_)
                 if mask is None else mask)
-            m_new = jnp.maximum(m, bm)
-            # guard: exp(-inf - -inf) on never-touched rows
-            a_old = jnp.where(m > _NEG_INF / 2, jnp.exp(m - m_new), 0.0)
-            a_blk = jnp.where(bm > _NEG_INF / 2, jnp.exp(bm - m_new), 0.0)
-            l_new = a_old * l + a_blk * bl
-            acc_new = a_old[..., None] * acc + a_blk[..., None] * bacc
-            return m_new, l_new, acc_new
+            return _fold((m, l, acc), bm, bl, bacc)
 
         if causal:
             # src > rank ⇒ every key is in the future: skip the matmuls
@@ -136,20 +158,10 @@ def _ring_bwd(axis_name, causal, scale, res, do):
         def compute(k_cur=k_cur, v_cur=v_cur, dk_cur=dk_cur, dv_cur=dv_cur,
                     dq=dq, src=src):
             mask = _step_mask(rank, src, s_local, causal)
-            k32 = k_cur.astype(jnp.float32)
-            v32 = v_cur.astype(jnp.float32)
-            s = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * scale_v
-            if mask is not None:
-                s = jnp.where(mask, s, _NEG_INF)
-            p = jnp.exp(s - lse[..., None])                   # exact softmax
-            if mask is not None:
-                p = jnp.where(mask, p, 0.0)
-            dv_new = dv_cur + jnp.einsum("bhqk,bhqd->bhkd", p, do32)
-            dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v32)
-            ds = p * (dp - delta[..., None]) * scale_v
-            dq_new = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k32)
-            dk_new = dk_cur + jnp.einsum("bhqk,bhqd->bhkd", ds, q32)
-            return dk_new, dv_new, dq_new
+            bq, bk, bv = _block_grads(
+                q32, do32, lse, delta, k_cur.astype(jnp.float32),
+                v_cur.astype(jnp.float32), scale_v, mask)
+            return dk_cur + bk, dv_cur + bv, dq + bq
 
         if causal:
             dk_cur, dv_cur, dq = jax.lax.cond(
@@ -243,11 +255,8 @@ def _zz_halves(t):
     return t[:, :, :half], t[:, :, half:]
 
 
-def _zz_pair_mask(qc, kc, half, causal_within):
-    """Mask for (q chunk id qc, k chunk id kc) pair; None = full."""
-    del qc, kc
-    if not causal_within:
-        return None
+def _zz_causal_mask(half):
+    """Within-chunk causal mask for the zigzag diagonal pairs."""
     i = jnp.arange(half)
     return (i[None, :] <= i[:, None])[None, None]
 
@@ -276,15 +285,7 @@ def _zz_fwd(q, k, v, axis_name, scale):
     perm = [(i, (i + 1) % cp) for i in range(cp)]
 
     q0, q1 = _zz_halves(q.astype(jnp.float32))
-    causal_mask = _zz_pair_mask(0, 0, half, True)
-
-    def fold(state, bm, bl, bacc):
-        m, l, acc = state
-        m_new = jnp.maximum(m, bm)
-        a_old = jnp.where(m > _NEG_INF / 2, jnp.exp(m - m_new), 0.0)
-        a_blk = jnp.where(bm > _NEG_INF / 2, jnp.exp(bm - m_new), 0.0)
-        return (m_new, a_old * l + a_blk * bl,
-                a_old[..., None] * acc + a_blk[..., None] * bacc)
+    causal_mask = _zz_causal_mask(half)
 
     def body(t, carry):
         k_cur, v_cur, st0, st1 = carry
@@ -297,16 +298,16 @@ def _zz_fwd(q, k, v, axis_name, scale):
         # causal-within when equal
         def q0k0(st0=st0, k0=k0, v0=v0, src=src):
             mask = jnp.where(src == rank, causal_mask, full)
-            return fold(st0, *_block_attn(q0, k0, v0, scale_v, mask))
+            return _fold(st0, *_block_attn(q0, k0, v0, scale_v, mask))
 
         st0 = jax.lax.cond(src <= rank, q0k0, lambda: st0)
         # pair (q1, k0): q chunk 2cp-1-rank >= cp > src — always full
-        st1 = fold(st1, *_block_attn(q1, k0, v0, scale_v, full))
+        st1 = _fold(st1, *_block_attn(q1, k0, v0, scale_v, full))
         # pair (q1, k1): chunk ids (2cp-1-rank, 2cp-1-src) — live iff
         # src >= rank; causal-within when equal
         def q1k1(st1=st1, k1=k1, v1=v1, src=src):
             mask = jnp.where(src == rank, causal_mask, full)
-            return fold(st1, *_block_attn(q1, k1, v1, scale_v, mask))
+            return _fold(st1, *_block_attn(q1, k1, v1, scale_v, mask))
 
         st1 = jax.lax.cond(src >= rank, q1k1, lambda: st1)
         # pair (q0, k1): k chunk >= cp > q chunk — never live
@@ -345,20 +346,8 @@ def _zz_bwd(axis_name, scale, res, do):
     do0, do1 = _zz_halves(do32)
     lse0, lse1 = lse[:, :, :half], lse[:, :, half:]
     dl0, dl1 = delta[:, :, :half], delta[:, :, half:]
-    causal_mask = _zz_pair_mask(0, 0, half, True)
+    causal_mask = _zz_causal_mask(half)
     full = jnp.ones((1, 1, half, half), jnp.bool_)
-
-    def pair_grads(qh, doh, lseh, deltah, kh, vh, mask):
-        """One (q-half, kv-half) pair: (dq_h, dk_h, dv_h) contributions."""
-        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale_v
-        s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.where(mask, jnp.exp(s - lseh[..., None]), 0.0)
-        dv = jnp.einsum("bhqk,bhqd->bhkd", p, doh)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", doh, vh)
-        ds = p * (dp - deltah[..., None]) * scale_v
-        dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kh)
-        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qh)
-        return dq, dk, dv
 
     def body(t, carry):
         k_cur, v_cur, dk_cur, dv_cur, dq = carry
@@ -371,17 +360,17 @@ def _zz_bwd(axis_name, scale, res, do):
 
         def p00(dq0=dq0, dk0=dk0, dv0=dv0, k0=k0, v0=v0, src=src):
             mask = jnp.where(src == rank, causal_mask, full)
-            a, bk, bv = pair_grads(q0, do0, lse0, dl0, k0, v0, mask)
+            a, bk, bv = _block_grads(q0, do0, lse0, dl0, k0, v0, scale_v, mask)
             return dq0 + a, dk0 + bk, dv0 + bv
 
         dq0, dk0, dv0 = jax.lax.cond(src <= rank, p00,
                                      lambda: (dq0, dk0, dv0))
-        a, bk, bv = pair_grads(q1, do1, lse1, dl1, k0, v0, full)
+        a, bk, bv = _block_grads(q1, do1, lse1, dl1, k0, v0, scale_v, full)
         dq1, dk0, dv0 = dq1 + a, dk0 + bk, dv0 + bv
 
         def p11(dq1=dq1, dk1=dk1, dv1=dv1, k1=k1, v1=v1, src=src):
             mask = jnp.where(src == rank, causal_mask, full)
-            a, bk, bv = pair_grads(q1, do1, lse1, dl1, k1, v1, mask)
+            a, bk, bv = _block_grads(q1, do1, lse1, dl1, k1, v1, scale_v, mask)
             return dq1 + a, dk1 + bk, dv1 + bv
 
         dq1, dk1, dv1 = jax.lax.cond(src >= rank, p11,
