@@ -46,6 +46,12 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     remat_blocks: bool = False
     attention_impl: str = "flash"           # "flash" | "fused_softmax"
+    # Megatron dropout knobs (--attention-dropout / --hidden-dropout,
+    # apex/transformer/tensor_parallel/tests/arguments.py:345-348).
+    # Active only when the model is applied with deterministic=False and
+    # a 'dropout' rng; attention dropout runs INSIDE the flash kernel.
+    attention_dropout: float = 0.0
+    hidden_dropout: float = 0.0
 
     @property
     def ffn(self):
@@ -56,7 +62,7 @@ class ParallelSelfAttention(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, deterministic: bool = True):
         cfg = self.cfg
         h = cfg.hidden_size
         tp = ps.get_tensor_model_parallel_world_size()
@@ -74,12 +80,24 @@ class ParallelSelfAttention(nn.Module):
             raise ValueError(
                 f"attention_impl must be 'flash' or 'fused_softmax', got "
                 f"{cfg.attention_impl!r}")
+        drop = (cfg.attention_dropout
+                if (cfg.attention_dropout > 0 and not deterministic) else 0.0)
         if cfg.attention_impl == "flash":
             qh = q.transpose(0, 2, 1, 3)          # [b, hp, s, d]
             kh = k.transpose(0, 2, 1, 3)
             vh = v.transpose(0, 2, 1, 3)
+            seed = None
+            if drop > 0.0:
+                # fold the tp rank into the seed: the kernel hashes the
+                # LOCAL head index, so replicated rngs would repeat masks
+                # across head shards (Megatron's per-rank RNG offsets,
+                # apex/transformer/tensor_parallel/random.py:131-206)
+                seed = (jax.random.randint(self.make_rng("dropout"), (), 0,
+                                           2 ** 30 - 1, jnp.int32)
+                        + ps.get_tensor_model_parallel_rank())
             ctx = flash_attention(qh, kh, vh, causal=True,
-                                  scale=head_dim ** -0.5)
+                                  scale=head_dim ** -0.5,
+                                  dropout_rate=drop, dropout_seed=seed)
             ctx = ctx.transpose(0, 2, 1, 3)       # [b, s, hp, d]
         else:  # "fused_softmax": the unfused numerics-debug path
             scores = jnp.einsum("bshd,bthd->bhst", q, k,
@@ -90,6 +108,9 @@ class ParallelSelfAttention(nn.Module):
                 scale=head_dim ** -0.5,
             )
             probs = softmax(scores.astype(cfg.dtype))
+            if drop > 0.0:
+                probs = nn.Dropout(drop, deterministic=False)(
+                    probs, rng=self.make_rng("dropout"))
             ctx = jnp.einsum("bhst,bthd->bshd", probs.astype(cfg.dtype), v,
                              preferred_element_type=jnp.float32).astype(cfg.dtype)
         ctx = ctx.reshape(b, s, heads_per * head_dim)
@@ -117,21 +138,29 @@ class GPTBlock(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, deterministic: bool = True):
         cfg = self.cfg
+
+        def hdrop(y):
+            if cfg.hidden_dropout > 0 and not deterministic:
+                return nn.Dropout(cfg.hidden_dropout, deterministic=False)(
+                    y, rng=self.make_rng("dropout"))
+            return y
+
         h = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln1")(
             x.astype(jnp.float32)).astype(cfg.dtype)
-        x = x + ParallelSelfAttention(cfg, name="attn")(h)
+        x = x + hdrop(ParallelSelfAttention(cfg, name="attn")(
+            h, deterministic=deterministic))
         h = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln2")(
             x.astype(jnp.float32)).astype(cfg.dtype)
-        return x + ParallelMLP(cfg, name="mlp")(h)
+        return x + hdrop(ParallelMLP(cfg, name="mlp")(h))
 
 
 class GPT(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, ids):
+    def __call__(self, ids, deterministic: bool = True):
         cfg = self.cfg
         wte = VocabParallelEmbedding(
             num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
@@ -140,9 +169,12 @@ class GPT(nn.Module):
         pos = self.param("wpe", nn.initializers.normal(0.02),
                          (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
         x = x + pos[None, :ids.shape[1]].astype(cfg.dtype)
-        block_cls = nn.remat(GPTBlock) if cfg.remat_blocks else GPTBlock
+        # static_argnums: `deterministic` is a Python bool branching the
+        # dropout guards — it must stay static through remat
+        block_cls = (nn.remat(GPTBlock, static_argnums=(2,))
+                     if cfg.remat_blocks else GPTBlock)
         for i in range(cfg.num_layers):
-            x = block_cls(cfg, name=f"block_{i}")(x)
+            x = block_cls(cfg, name=f"block_{i}")(x, deterministic)
         x = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln_f")(
             x.astype(jnp.float32)).astype(cfg.dtype)
         # vocab-parallel logits, tied to the embedding shard
