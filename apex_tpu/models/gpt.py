@@ -109,8 +109,12 @@ class ParallelSelfAttention(nn.Module):
             )
             probs = softmax(scores.astype(cfg.dtype))
             if drop > 0.0:
-                probs = nn.Dropout(drop, deterministic=False)(
-                    probs, rng=self.make_rng("dropout"))
+                # fold in the tp rank: identical keys across head shards
+                # would repeat dropout masks (see the flash path)
+                key = jax.random.fold_in(
+                    self.make_rng("dropout"),
+                    ps.get_tensor_model_parallel_rank())
+                probs = nn.Dropout(drop, deterministic=False)(probs, rng=key)
             ctx = jnp.einsum("bhst,bthd->bshd", probs.astype(cfg.dtype), v,
                              preferred_element_type=jnp.float32).astype(cfg.dtype)
         ctx = ctx.reshape(b, s, heads_per * head_dim)
