@@ -28,7 +28,8 @@ from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.enums import AttnMaskType
 from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
 from apex_tpu.transformer.tensor_parallel import (
-    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    mappings as tp_mappings)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -164,4 +165,8 @@ class Bert(nn.Module):
         x = jax.nn.gelu(x.astype(jnp.float32), approximate=True)
         x = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="mlm_ln")(
             x).astype(cfg.dtype)
+        if ps.get_tensor_model_parallel_world_size() > 1:
+            # Megatron "f" before the tied output embedding: bwd
+            # all-reduces the per-vocab-shard partial d(x) (see gpt.py)
+            x = tp_mappings.copy_to_tensor_model_parallel_region(x)
         return wte.attend(x)

@@ -32,7 +32,7 @@ from apex_tpu.transformer.enums import AttnMaskType
 from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
 from apex_tpu.transformer.tensor_parallel import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
-    vocab_parallel_cross_entropy)
+    mappings as tp_mappings, vocab_parallel_cross_entropy)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,6 +52,11 @@ class GPTConfig:
     # a 'dropout' rng; attention dropout runs INSIDE the flash kernel.
     attention_dropout: float = 0.0
     hidden_dropout: float = 0.0
+    # Megatron-SP: activations between blocks are sequence-sharded over
+    # the tensor axis; Column layers all-gather the sequence before their
+    # GEMM and Row layers reduce-scatter it back (tensor_parallel layers'
+    # sequence_parallel flags with sequence_dim=1 for [b, s, h]).
+    sequence_parallel: bool = False
 
     @property
     def ffn(self):
@@ -69,8 +74,10 @@ class ParallelSelfAttention(nn.Module):
         heads_per = cfg.num_heads // tp
         head_dim = h // cfg.num_heads
 
+        sp = cfg.sequence_parallel and tp > 1
         qkv = ColumnParallelLinear(
             input_size=h, output_size=3 * h, gather_output=False,
+            sequence_parallel=sp, sequence_dim=1,
             name="qkv")(x)                       # [b, s, 3h/tp]
         b, s, _ = qkv.shape
         qkv = qkv.reshape(b, s, heads_per, 3 * head_dim)
@@ -120,6 +127,7 @@ class ParallelSelfAttention(nn.Module):
         ctx = ctx.reshape(b, s, heads_per * head_dim)
         return RowParallelLinear(
             input_size=h, output_size=h, input_is_parallel=True,
+            sequence_parallel=sp, sequence_dim=1,
             name="proj")(ctx)
 
 
@@ -129,13 +137,17 @@ class ParallelMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
+        sp = (cfg.sequence_parallel
+              and ps.get_tensor_model_parallel_world_size() > 1)
         y = ColumnParallelLinear(
             input_size=cfg.hidden_size, output_size=cfg.ffn,
-            gather_output=False, name="fc1")(x)
+            gather_output=False, sequence_parallel=sp, sequence_dim=1,
+            name="fc1")(x)
         y = jax.nn.gelu(y.astype(jnp.float32), approximate=True).astype(x.dtype)
         return RowParallelLinear(
             input_size=cfg.ffn, output_size=cfg.hidden_size,
-            input_is_parallel=True, name="fc2")(y)
+            input_is_parallel=True, sequence_parallel=sp, sequence_dim=1,
+            name="fc2")(y)
 
 
 class GPTBlock(nn.Module):
@@ -147,8 +159,15 @@ class GPTBlock(nn.Module):
 
         def hdrop(y):
             if cfg.hidden_dropout > 0 and not deterministic:
+                key = self.make_rng("dropout")
+                if cfg.sequence_parallel:
+                    # sequence-sharded activations hold DIFFERENT tokens
+                    # per tp rank: distinct masks (without SP the
+                    # activations are replicated and must drop identically)
+                    key = jax.random.fold_in(
+                        key, ps.get_tensor_model_parallel_rank())
                 return nn.Dropout(cfg.hidden_dropout, deterministic=False)(
-                    y, rng=self.make_rng("dropout"))
+                    y, rng=key)
             return y
 
         h = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln1")(
@@ -173,6 +192,17 @@ class GPT(nn.Module):
         pos = self.param("wpe", nn.initializers.normal(0.02),
                          (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
         x = x + pos[None, :ids.shape[1]].astype(cfg.dtype)
+        sp = (cfg.sequence_parallel
+              and ps.get_tensor_model_parallel_world_size() > 1)
+        if sp:
+            tp = ps.get_tensor_model_parallel_world_size()
+            if ids.shape[1] % tp:
+                raise ValueError(
+                    f"sequence_parallel requires seq len ({ids.shape[1]}) "
+                    f"divisible by tp ({tp})")
+            # Megatron-SP: activations between blocks are seq-sharded
+            x = tp_mappings.scatter_to_sequence_parallel_region(
+                x, ps.TENSOR_AXIS, 1)
         # static_argnums: `deterministic` is a Python bool branching the
         # dropout guards — it must stay static through remat
         block_cls = (nn.remat(GPTBlock, static_argnums=(2,))
@@ -181,6 +211,16 @@ class GPT(nn.Module):
             x = block_cls(cfg, name=f"block_{i}")(x, deterministic)
         x = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln_f")(
             x.astype(jnp.float32)).astype(cfg.dtype)
+        if sp:
+            x = tp_mappings.gather_from_sequence_parallel_region(
+                x, ps.TENSOR_AXIS, 1)
+        elif ps.get_tensor_model_parallel_world_size() > 1:
+            # the Megatron "f" before the output-embedding matmul
+            # (parallel_lm_logits): fwd identity, bwd all-reduce — each
+            # rank's d(x) from its vocab shard is a partial sum; without
+            # this, wpe/wte/ln_f and the whole residual stream get 1/tp
+            # of their gradient (r1 bug, caught by an SP FD check)
+            x = tp_mappings.copy_to_tensor_model_parallel_region(x)
         # vocab-parallel logits, tied to the embedding shard
         logits = wte.attend(x)
         return logits  # [b, s, V/tp] (full V at tp=1)
@@ -189,3 +229,19 @@ class GPT(nn.Module):
         logits = self.apply(variables, ids)
         losses = vocab_parallel_cross_entropy(logits, labels)
         return jnp.mean(losses)
+
+    @staticmethod
+    def sequence_parallel_grad_filter(path_names, leaf) -> bool:
+        """Selects params whose grads are per-tp-rank partials under
+        ``sequence_parallel=True``: layernorm params and the biases added
+        after the sequence reduce-scatter (proj/fc2). Pass to
+        ``tensor_parallel.mappings.allreduce_sequence_parallel_gradients``
+        in the train step (the Megatron
+        ``allreduce_sequence_parallel_gradients`` contract — without it
+        the replicated params silently diverge across tp ranks)."""
+        del leaf
+        names = [str(n).lower() for n in path_names]
+        if any(n.startswith("ln") for n in names):
+            return True
+        return ("bias" in names
+                and any(n in ("proj", "fc2") for n in names))

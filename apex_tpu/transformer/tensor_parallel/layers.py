@@ -94,6 +94,7 @@ class ColumnParallelLinear(nn.Module):
     gather_output: bool = True
     skip_bias_add: bool = False
     sequence_parallel: bool = False
+    sequence_dim: int = 0          # 0 = [s, b, h] (Megatron), 1 = [b, s, h]
     axis_name: str = ps.TENSOR_AXIS
     init_method: Callable = nn.initializers.lecun_normal()
     param_dtype: Any = jnp.float32
@@ -107,7 +108,8 @@ class ColumnParallelLinear(nn.Module):
             _sliced_init(self.init_method, (self.input_size, self.output_size), 1, self.axis_name),
             (self.input_size, out_per), self.param_dtype)
         if self.sequence_parallel and world > 1:
-            x = mappings.gather_from_sequence_parallel_region(x, self.axis_name)
+            x = mappings.gather_from_sequence_parallel_region(
+                x, self.axis_name, self.sequence_dim)
         elif world > 1:
             x = mappings.copy_to_tensor_model_parallel_region(x, self.axis_name)
         y = jnp.dot(x, kernel.astype(x.dtype),
@@ -142,6 +144,7 @@ class RowParallelLinear(nn.Module):
     input_is_parallel: bool = False
     skip_bias_add: bool = False
     sequence_parallel: bool = False
+    sequence_dim: int = 0          # 0 = [s, b, h] (Megatron), 1 = [b, s, h]
     axis_name: str = ps.TENSOR_AXIS
     init_method: Callable = nn.initializers.lecun_normal()
     param_dtype: Any = jnp.float32
@@ -160,7 +163,8 @@ class RowParallelLinear(nn.Module):
                     preferred_element_type=jnp.float32).astype(x.dtype)
         if world > 1:
             if self.sequence_parallel:
-                y = mappings.reduce_scatter_to_sequence_parallel_region(y, self.axis_name)
+                y = mappings.reduce_scatter_to_sequence_parallel_region(
+                    y, self.axis_name, self.sequence_dim)
             else:
                 y = mappings.reduce_from_tensor_model_parallel_region(y, self.axis_name)
         bias = None

@@ -106,29 +106,80 @@ def _gather_bwd(axis_name, dim, _, dy):
 gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
 
 
-# -- sequence-parallel variants (dim 0 = sequence, Megatron-SP convention) --
+# -- sequence-parallel variants (default dim 0 = sequence, the Megatron
+#    [s, b, h] convention; pass dim=1 for batch-first [b, s, h] models) --
 
-def scatter_to_sequence_parallel_region(x, axis_name: str = ps.TENSOR_AXIS):
-    return scatter_to_tensor_model_parallel_region(x, axis_name, 0)
-
-
-def gather_from_sequence_parallel_region(x, axis_name: str = ps.TENSOR_AXIS):
-    return gather_from_tensor_model_parallel_region(x, axis_name, 0)
+def scatter_to_sequence_parallel_region(x, axis_name: str = ps.TENSOR_AXIS,
+                                        dim: int = 0):
+    return scatter_to_tensor_model_parallel_region(x, axis_name, dim)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def reduce_scatter_to_sequence_parallel_region(x, axis_name: str = ps.TENSOR_AXIS):
-    """fwd reduce-scatter along dim 0, bwd all-gather — the Megatron-SP
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_sequence_parallel_region(x, axis_name: str = ps.TENSOR_AXIS,
+                                         dim: int = 0):
+    """fwd all-gather along the sequence ``dim``; bwd REDUCE-SCATTER —
+    under SP every rank's cotangent w.r.t. the gathered sequence is a
+    partial sum (e.g. ``dy @ W_shard^T`` in a column-parallel backward),
+    so the backward must sum across ranks while re-sharding (Megatron's
+    ``_GatherFromSequenceParallelRegion`` with
+    ``tensor_parallel_output_grad=True``). A plain local chunk here
+    silently drops (tp-1)/tp of the gradient."""
+    return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _sp_gather_fwd(x, axis_name, dim):
+    return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True), None
+
+
+def _sp_gather_bwd(axis_name, dim, _, dy):
+    return (jax.lax.psum_scatter(dy, axis_name, scatter_dimension=dim,
+                                 tiled=True),)
+
+
+gather_from_sequence_parallel_region.defvjp(_sp_gather_fwd, _sp_gather_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def reduce_scatter_to_sequence_parallel_region(
+        x, axis_name: str = ps.TENSOR_AXIS, dim: int = 0):
+    """fwd reduce-scatter along ``dim``, bwd all-gather — the Megatron-SP
     "g" in the sequence-parallel MLP/attention sandwich."""
-    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=dim,
+                                tiled=True)
 
 
-def _rs_fwd(x, axis_name):
-    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True), None
+def _rs_fwd(x, axis_name, dim):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=dim,
+                                tiled=True), None
 
 
-def _rs_bwd(axis_name, _, dy):
-    return (jax.lax.all_gather(dy, axis_name, axis=0, tiled=True),)
+def _rs_bwd(axis_name, dim, _, dy):
+    return (jax.lax.all_gather(dy, axis_name, axis=dim, tiled=True),)
 
 
 reduce_scatter_to_sequence_parallel_region.defvjp(_rs_fwd, _rs_bwd)
+
+
+def allreduce_sequence_parallel_gradients(grads, is_sp_partial,
+                                          axis_name: str = ps.TENSOR_AXIS):
+    """psum the gradients of logically-replicated params whose grads are
+    per-rank partials under sequence parallelism (layernorm scales/biases
+    and post-reduce-scatter biases see only the local token shard) — the
+    Megatron ``allreduce_sequence_parallel_gradients`` analog.
+
+    ``is_sp_partial(path_tuple, leaf) -> bool`` selects the leaves; the
+    path entries are plain strings (dict keys, attribute names, or
+    sequence indices).
+    """
+    def _name(p):
+        for attr in ("key", "name", "idx"):
+            if hasattr(p, attr):
+                return str(getattr(p, attr))
+        return str(p)
+
+    def fix(path, leaf):
+        if is_sp_partial(tuple(_name(p) for p in path), leaf):
+            return ps.psum_if_bound(leaf, axis_name)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(fix, grads)
