@@ -44,6 +44,9 @@ class BertConfig:
     dtype: Any = jnp.bfloat16
     use_flash: bool = True
     remat_blocks: bool = False
+    # Megatron-SP (see gpt.py): activations between layers are
+    # sequence-sharded over the tensor axis
+    sequence_parallel: bool = False
 
     @property
     def ffn(self):
@@ -70,8 +73,10 @@ class BertSelfAttention(nn.Module):
         heads_per = cfg.num_heads // tp
         head_dim = h // cfg.num_heads
 
+        sp = ps.sequence_parallel_active(cfg.sequence_parallel)
         qkv = ColumnParallelLinear(
             input_size=h, output_size=3 * h, gather_output=False,
+            sequence_parallel=sp, sequence_dim=1,
             name="qkv")(x)
         b, s, _ = qkv.shape
         qkv = qkv.reshape(b, s, heads_per, 3 * head_dim)
@@ -102,6 +107,7 @@ class BertSelfAttention(nn.Module):
         ctx = ctx.reshape(b, s, heads_per * head_dim)
         return RowParallelLinear(
             input_size=h, output_size=h, input_is_parallel=True,
+            sequence_parallel=sp, sequence_dim=1,
             name="proj")(ctx)
 
 
@@ -116,13 +122,16 @@ class BertLayer(nn.Module):
         a = BertSelfAttention(cfg, name="attn")(x, pad_mask)
         x = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln1")(
             (x + a).astype(jnp.float32)).astype(cfg.dtype)
+        sp = ps.sequence_parallel_active(cfg.sequence_parallel)
         y = ColumnParallelLinear(
             input_size=cfg.hidden_size, output_size=cfg.ffn,
-            gather_output=False, name="fc1")(x)
+            gather_output=False, sequence_parallel=sp, sequence_dim=1,
+            name="fc1")(x)
         y = jax.nn.gelu(y.astype(jnp.float32), approximate=True).astype(cfg.dtype)
         y = RowParallelLinear(
             input_size=cfg.ffn, output_size=cfg.hidden_size,
-            input_is_parallel=True, name="fc2")(y)
+            input_is_parallel=True, sequence_parallel=sp, sequence_dim=1,
+            name="fc2")(y)
         return FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln2")(
             (x + y).astype(jnp.float32)).astype(cfg.dtype)
 
@@ -153,15 +162,26 @@ class Bert(nn.Module):
                 x = x + jnp.take(tok_type, type_ids, axis=0).astype(cfg.dtype)
         x = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln_emb")(
             x.astype(jnp.float32)).astype(cfg.dtype)
+        sp = ps.sequence_parallel_active(cfg.sequence_parallel)
+        if sp:
+            tp = ps.get_tensor_model_parallel_world_size()
+            if ids.shape[1] % tp:
+                raise ValueError(
+                    f"sequence_parallel requires seq len ({ids.shape[1]}) "
+                    f"divisible by tp ({tp})")
+            x = tp_mappings.scatter_to_sequence_parallel_region(
+                x, ps.TENSOR_AXIS, 1)
 
         layer_cls = nn.remat(BertLayer) if cfg.remat_blocks else BertLayer
         for i in range(cfg.num_layers):
             x = layer_cls(cfg, name=f"layer_{i}")(x, pad_mask)
 
-        # MLM transform head (dense+gelu+LN), then tied decoder
+        # MLM transform head (dense+gelu+LN), then tied decoder;
+        # under SP the mlm_dense gathers the sequence back to full length
         x = ColumnParallelLinear(
             input_size=cfg.hidden_size, output_size=cfg.hidden_size,
-            gather_output=True, name="mlm_dense")(x)
+            gather_output=True, sequence_parallel=sp, sequence_dim=1,
+            name="mlm_dense")(x)
         x = jax.nn.gelu(x.astype(jnp.float32), approximate=True)
         x = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="mlm_ln")(
             x).astype(cfg.dtype)
@@ -170,3 +190,17 @@ class Bert(nn.Module):
             # all-reduces the per-vocab-shard partial d(x) (see gpt.py)
             x = tp_mappings.copy_to_tensor_model_parallel_region(x)
         return wte.attend(x)
+
+    @staticmethod
+    def sequence_parallel_grad_filter(path_names, leaf) -> bool:
+        """Params whose grads are per-tp-rank partials under SP: the
+        in-block layernorms (operating on sequence-sharded activations)
+        and the biases added after the sequence reduce-scatter.
+        ``ln_emb``/``mlm_ln`` run on the full (replicated) sequence and
+        must NOT be reduced."""
+        del leaf
+        names = [str(n).lower() for n in path_names]
+        if any(n in ("ln1", "ln2") for n in names):
+            return True
+        return ("bias" in names
+                and any(n in ("proj", "fc2") for n in names))

@@ -74,7 +74,7 @@ class ParallelSelfAttention(nn.Module):
         heads_per = cfg.num_heads // tp
         head_dim = h // cfg.num_heads
 
-        sp = cfg.sequence_parallel and tp > 1
+        sp = ps.sequence_parallel_active(cfg.sequence_parallel)
         qkv = ColumnParallelLinear(
             input_size=h, output_size=3 * h, gather_output=False,
             sequence_parallel=sp, sequence_dim=1,
@@ -137,8 +137,7 @@ class ParallelMLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        sp = (cfg.sequence_parallel
-              and ps.get_tensor_model_parallel_world_size() > 1)
+        sp = ps.sequence_parallel_active(cfg.sequence_parallel)
         y = ColumnParallelLinear(
             input_size=cfg.hidden_size, output_size=cfg.ffn,
             gather_output=False, sequence_parallel=sp, sequence_dim=1,
@@ -160,7 +159,7 @@ class GPTBlock(nn.Module):
         def hdrop(y):
             if cfg.hidden_dropout > 0 and not deterministic:
                 key = self.make_rng("dropout")
-                if cfg.sequence_parallel:
+                if ps.sequence_parallel_active(cfg.sequence_parallel):
                     # sequence-sharded activations hold DIFFERENT tokens
                     # per tp rank: distinct masks (without SP the
                     # activations are replicated and must drop identically)
@@ -192,8 +191,7 @@ class GPT(nn.Module):
         pos = self.param("wpe", nn.initializers.normal(0.02),
                          (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
         x = x + pos[None, :ids.shape[1]].astype(cfg.dtype)
-        sp = (cfg.sequence_parallel
-              and ps.get_tensor_model_parallel_world_size() > 1)
+        sp = ps.sequence_parallel_active(cfg.sequence_parallel)
         if sp:
             tp = ps.get_tensor_model_parallel_world_size()
             if ids.shape[1] % tp:

@@ -173,6 +173,11 @@ def pmax_if_bound(x, axis_name: str):
         return x
 
 
+def sequence_parallel_active(flag: bool) -> bool:
+    """Megatron-SP is in effect only when requested AND tp > 1."""
+    return bool(flag) and get_tensor_model_parallel_world_size() > 1
+
+
 def axis_size_if_bound(axis_name) -> int:
     """Size of ``axis_name`` inside shard_map, 1 when unbound/None."""
     if axis_name is None:

@@ -403,15 +403,18 @@ def test_gpt_tp_grads_match_finite_differences(sp):
     ps.destroy_model_parallel()
 
 
-def test_bert_tp_grads_match_finite_differences():
+@pytest.mark.parametrize("sp", [False, True])
+def test_bert_tp_grads_match_finite_differences(sp):
     """BERT's tied-embedding MLM head needs the same 'f' collective as
-    GPT; FD check of the tp=4 backward (r1 1/tp-gradient bug)."""
+    GPT; FD check of the tp=4 backward (r1 1/tp-gradient bug), with and
+    without sequence parallelism."""
     from apex_tpu.models import Bert, BertConfig
 
     ps.destroy_model_parallel()
     mesh = ps.initialize_model_parallel(tensor_model_parallel_size_=4)
     cfg = BertConfig(vocab_size=64, max_seq_len=16, hidden_size=32,
-                     num_layers=1, num_heads=4, dtype=jnp.float32)
+                     num_layers=1, num_heads=4, dtype=jnp.float32,
+                     sequence_parallel=sp)
     model = Bert(cfg)
     rng = np.random.RandomState(2)
     ids = jnp.asarray(rng.randint(0, 64, (2, 16)))
@@ -437,4 +440,48 @@ def test_bert_tp_grads_match_finite_differences():
     fd, an = shard_map(inner, mesh=mesh, in_specs=(P(), P()),
                        out_specs=(P(), P()), check_vma=False)(ids, labels)
     np.testing.assert_allclose(float(an), float(fd), rtol=2e-2)
+    ps.destroy_model_parallel()
+
+
+def test_bert_sequence_parallel_grads_match_plain_tp():
+    """All-leaf grad parity at tp=4: SP BERT (with its grad filter) must
+    equal plain-TP BERT — pins Bert.sequence_parallel_grad_filter, which
+    the FD test (wpe only) cannot exercise."""
+    from apex_tpu.models import Bert, BertConfig
+    from apex_tpu.transformer.tensor_parallel import mappings as tpm
+
+    ps.destroy_model_parallel()
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size_=4)
+    kw = dict(vocab_size=64, max_seq_len=16, hidden_size=32,
+              num_layers=1, num_heads=4, dtype=jnp.float32)
+    rng = np.random.RandomState(3)
+    ids = jnp.asarray(rng.randint(0, 64, (2, 16)))
+    labels = jnp.asarray(rng.randint(0, 64, (2, 16)))
+
+    def grads_of(model, sp):
+        def inner(ids, labels):
+            v = model.init(jax.random.PRNGKey(0), ids)
+
+            def loss_fn(v):
+                logits = model.apply(v, ids)
+                return jnp.mean(vocab_parallel_cross_entropy(logits, labels))
+
+            loss, g = jax.value_and_grad(loss_fn)(v)
+            if sp:
+                g = tpm.allreduce_sequence_parallel_gradients(
+                    g, Bert.sequence_parallel_grad_filter)
+            return loss, g
+        return shard_map(inner, mesh=mesh, in_specs=(P(), P()),
+                         out_specs=(P(), P()), check_vma=False)(ids, labels)
+
+    loss_tp, g_tp = grads_of(Bert(BertConfig(**kw)), sp=False)
+    loss_sp, g_sp = grads_of(Bert(BertConfig(**kw, sequence_parallel=True)),
+                             sp=True)
+    np.testing.assert_allclose(float(loss_sp), float(loss_tp), rtol=1e-5)
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(g_tp)[0],
+            jax.tree_util.tree_flatten_with_path(g_sp)[0]):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+            err_msg=str(pa))
     ps.destroy_model_parallel()
