@@ -7,8 +7,16 @@ backward producing ``softmax - one_hot`` on each shard without ever
 gathering the full vocab.
 
 TPU: same three collectives over the ``tensor`` mesh axis inside a
-``custom_vjp`` — forward saves only the normalized exp-logits shard and
-the target mask (the reference's trick, :71-76), backward is local.
+``custom_vjp``. Memory layout differs from the reference (which saves the
+full softmax shard, :71-76): the forward saves only the logits (already
+live — they are the primal input), the row max, and the row sum-exp, and
+the backward recomputes ``softmax = exp(logits - max)/sum_exp``
+elementwise — the ``apex.contrib.xentropy`` lse-saving trick
+(``apex/contrib/csrc/xentropy/xentropy_kernel.cu``) applied to the
+vocab-parallel loss. This avoids materializing an fp32 [..., V/tp]
+residual (4 bytes/logit) between forward and backward, and the logits
+gradient is emitted in the *logits dtype*, so with bf16 logits the two
+big vocab matmuls of the embedding backward run on the bf16 MXU path.
 Optional label smoothing mirrors upstream Megatron's extension.
 """
 
@@ -39,9 +47,9 @@ def _vce_core(logits, target, axis_name):
     start = rank * part_v
 
     # 1) global max for stability (cross_entropy.py:28-33)
-    lmax = jnp.max(logits, axis=-1)
+    lmax = jnp.max(logits, axis=-1).astype(jnp.float32)
     lmax = ps.pmax_if_bound(lmax, axis_name)
-    shifted = logits.astype(jnp.float32) - lmax[..., None].astype(jnp.float32)
+    shifted = logits.astype(jnp.float32) - lmax[..., None]
 
     # 2) predicted (target) logit: local-range gather + allreduce (:35-57)
     local_t = target - start
@@ -52,38 +60,43 @@ def _vce_core(logits, target, axis_name):
     pred = ps.psum_if_bound(pred, axis_name)
 
     # 3) sum-exp allreduce (:59-69)
-    exp = jnp.exp(shifted)
-    sum_exp = ps.psum_if_bound(jnp.sum(exp, axis=-1), axis_name)
+    sum_exp = ps.psum_if_bound(jnp.sum(jnp.exp(shifted), axis=-1), axis_name)
 
     loss = jnp.log(sum_exp) - pred
-    softmax = exp / sum_exp[..., None]
-    return loss, softmax, in_range, local_t
+    return loss, lmax, sum_exp, in_range, local_t
 
 
 def _vce_fwd(logits, target, label_smoothing, axis_name):
-    loss, softmax, in_range, local_t = _vce_core(logits, target, axis_name)
+    loss, lmax, sum_exp, in_range, local_t = _vce_core(
+        logits, target, axis_name)
     if label_smoothing > 0.0:
-        # smoothed loss adds -eps/V * sum(log p) = eps/V * sum(lse - logit);
-        # computed from the saved softmax shard
-        vocab = softmax.shape[-1] * ps._axis_size(axis_name)
-        logp = jnp.log(jnp.maximum(softmax, 1e-30))
-        mean_logp = ps.psum_if_bound(jnp.sum(logp, axis=-1), axis_name) / vocab
+        # smoothed loss adds -eps/V * sum(log p); with
+        # log p = shifted - log(sum_exp) this is a single shifted-sum
+        # reduction — no softmax materialization
+        vocab = logits.shape[-1] * ps._axis_size(axis_name)
+        shifted_sum = ps.psum_if_bound(
+            jnp.sum(logits.astype(jnp.float32) - lmax[..., None], axis=-1),
+            axis_name)
+        mean_logp = shifted_sum / vocab - jnp.log(sum_exp)
         loss = (1.0 - label_smoothing) * loss - label_smoothing * mean_logp
-    return loss, (softmax, in_range, local_t)
+    return loss, (logits, lmax, sum_exp, in_range, local_t)
 
 
 def _vce_bwd(label_smoothing, axis_name, res, dloss):
-    softmax, in_range, local_t = res
-    part_v = softmax.shape[-1]
-    one_hot = jax.nn.one_hot(local_t, part_v, dtype=softmax.dtype)
+    logits, lmax, sum_exp, in_range, local_t = res
+    part_v = logits.shape[-1]
+    # recompute the softmax shard elementwise from the saved row stats
+    softmax = (jnp.exp(logits.astype(jnp.float32) - lmax[..., None])
+               / sum_exp[..., None])
+    one_hot = jax.nn.one_hot(local_t, part_v, dtype=jnp.float32)
     one_hot = one_hot * in_range[..., None]
     if label_smoothing > 0.0:
         vocab = part_v * ps._axis_size(axis_name)
         target_dist = (1.0 - label_smoothing) * one_hot + label_smoothing / vocab
     else:
         target_dist = one_hot
-    grad = (softmax - target_dist) * dloss[..., None].astype(softmax.dtype)
-    return grad, None
+    grad = (softmax - target_dist) * dloss[..., None].astype(jnp.float32)
+    return grad.astype(logits.dtype), None
 
 
 vocab_parallel_cross_entropy.defvjp(_vce_fwd, _vce_bwd)

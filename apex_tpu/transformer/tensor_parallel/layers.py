@@ -218,6 +218,12 @@ class VocabParallelEmbedding(nn.Module):
         return mappings.reduce_from_tensor_model_parallel_region(emb, self.axis_name)
 
     def attend(self, x):
-        """Logits against the table shard: [..., h] -> [..., V/tp]."""
-        return jnp.einsum("...h,vh->...v", x, self.embedding.astype(x.dtype),
-                          preferred_element_type=jnp.float32)
+        """Logits against the table shard: [..., h] -> [..., V/tp].
+
+        Logits come out in the activation dtype (MXU accumulation is fp32
+        internally either way): an fp32 [..., V/tp] output doubles the
+        write traffic of the step's single largest tensor and forces the
+        embedding-backward matmuls onto fp32 operands.
+        ``vocab_parallel_cross_entropy`` does its reductions in fp32.
+        """
+        return jnp.einsum("...h,vh->...v", x, self.embedding.astype(x.dtype))

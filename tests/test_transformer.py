@@ -166,6 +166,29 @@ def test_vocab_parallel_cross_entropy_grad(tp_mesh):
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_vocab_parallel_cross_entropy_bf16(smoothing):
+    """The bf16 logits path (bf16 ``attend`` output -> bf16 grad emission):
+    reductions run in fp32 internally, so the loss of bf16-valued logits
+    equals the fp32 loss of the same values, and the emitted bf16 gradient
+    is the fp32 gradient within one rounding step."""
+    rng = np.random.RandomState(5)
+    V = 64
+    logits16 = jnp.asarray(rng.randn(7, V) * 4, jnp.bfloat16)
+    logits32 = logits16.astype(jnp.float32)     # identical values
+    target = jnp.asarray(rng.randint(0, V, (7,)))
+
+    def total(l):
+        return jnp.sum(vocab_parallel_cross_entropy(l, target, smoothing))
+
+    loss16, g16 = jax.value_and_grad(total)(logits16)
+    loss32, g32 = jax.value_and_grad(total)(logits32)
+    assert g16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(float(loss16), float(loss32), rtol=2e-3)
+    np.testing.assert_allclose(np.asarray(g16, np.float32) if hasattr(g16, "astype") else g16,
+                               np.asarray(g32), rtol=0.02, atol=1e-3)
+
+
 def test_mappings_roundtrip(tp_mesh):
     x = jnp.arange(32, dtype=jnp.float32).reshape(4, 8)
 
