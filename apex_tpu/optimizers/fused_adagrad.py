@@ -7,6 +7,7 @@ gradient, ``adagrad_w_mode`` decoupled decay).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from apex_tpu.optimizers.base import FusedOptimizerBase
@@ -20,18 +21,21 @@ class FusedAdagrad(FusedOptimizerBase):
         self.adagrad_w_mode = adagrad_w_mode
         super().__init__(params, defaults, master_weights=master_weights)
 
-    def _init_slots(self, flat_p32, spec, group):
-        return {"sum": jnp.zeros_like(flat_p32)}
+    def _init_slots(self, p32, group):
+        return {"sum": jax.tree.map(jnp.zeros_like, p32)}
 
-    def _update(self, p, g, slots, step, group, spec):
+    def _update(self, p, g, slots, step, group):
         lr = jnp.asarray(group["lr"], jnp.float32)
         eps = group["eps"]
         wd = group.get("weight_decay", 0.0)
-        s = slots["sum"]
         if wd != 0.0 and not self.adagrad_w_mode:
-            g = g + wd * p
-        s = s + g * g
-        update = g / (jnp.sqrt(s) + eps)
-        if wd != 0.0 and self.adagrad_w_mode:
-            update = update + wd * p
-        return p - lr * update, {"sum": s}
+            g = jax.tree.map(lambda g, p: g + wd * p, g, p)
+        s = jax.tree.map(lambda s, g: s + g * g, slots["sum"], g)
+
+        def leaf(p, g, s):
+            update = g / (jnp.sqrt(s) + eps)
+            if wd != 0.0 and self.adagrad_w_mode:
+                update = update + wd * p
+            return p - lr * update
+
+        return jax.tree.map(leaf, p, g, s), {"sum": s}

@@ -6,11 +6,14 @@ storage dtype, ``adam_w_mode`` selecting decoupled weight decay vs L2,
 ``bias_correction`` flag, step-skip via the overflow noop flag).
 
 TPU: the whole update (two moment EMAs + bias correction + decay + write)
-is one fused elementwise pass over the fp32 flat buffer.
+is fused elementwise fp32 math, leaf-wise over the param pytree (one
+fused loop per leaf inside one jitted program — see base.py for why this
+beats a flat buffer on TPU).
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from apex_tpu.optimizers.base import FusedOptimizerBase
@@ -29,23 +32,15 @@ class FusedAdam(FusedOptimizerBase):
         self.adam_w_mode = adam_w_mode
         super().__init__(params, defaults, master_weights=master_weights)
 
-    def _init_slots(self, flat_p32, spec, group):
-        return {"exp_avg": jnp.zeros_like(flat_p32), "exp_avg_sq": jnp.zeros_like(flat_p32)}
+    def _init_slots(self, p32, group):
+        return {"exp_avg": jax.tree.map(jnp.zeros_like, p32),
+                "exp_avg_sq": jax.tree.map(jnp.zeros_like, p32)}
 
-    def _update(self, p, g, slots, step, group, spec):
+    def _update(self, p, g, slots, step, group):
         lr = jnp.asarray(group["lr"], jnp.float32)
         beta1, beta2 = group["betas"]
         eps = group["eps"]
         wd = group.get("weight_decay", 0.0)
-        m, v = slots["exp_avg"], slots["exp_avg_sq"]
-
-        if not self.adam_w_mode and wd != 0.0:
-            # ADAM_MODE_0 (L2): decay folded into the gradient
-            # (csrc/multi_tensor_adam.cu AdamFunctor L2 branch).
-            g = g + wd * p
-
-        m = beta1 * m + (1.0 - beta1) * g
-        v = beta2 * v + (1.0 - beta2) * g * g
 
         if group.get("bias_correction", True):
             stepf = step.astype(jnp.float32)
@@ -54,10 +49,24 @@ class FusedAdam(FusedOptimizerBase):
         else:
             bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
 
-        update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
-        if self.adam_w_mode and wd != 0.0:
-            update = update + wd * p
-        return p - lr * update, {"exp_avg": m, "exp_avg_sq": v}
+        if not self.adam_w_mode and wd != 0.0:
+            # ADAM_MODE_0 (L2): decay folded into the gradient
+            # (csrc/multi_tensor_adam.cu AdamFunctor L2 branch).
+            g = jax.tree.map(lambda g, p: g + wd * p, g, p)
+
+        m = jax.tree.map(lambda m, g: beta1 * m + (1.0 - beta1) * g,
+                         slots["exp_avg"], g)
+        v = jax.tree.map(lambda v, g: beta2 * v + (1.0 - beta2) * g * g,
+                         slots["exp_avg_sq"], g)
+
+        def leaf(p, m, v):
+            update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if self.adam_w_mode and wd != 0.0:
+                update = update + wd * p
+            return p - lr * update
+
+        new_p = jax.tree.map(leaf, p, m, v)
+        return new_p, {"exp_avg": m, "exp_avg_sq": v}
 
 
 class FusedAdamW(FusedAdam):

@@ -7,10 +7,12 @@ which gradient-clips by ``max_grad_norm`` against the global norm, does an
 Adam-style moment update, and applies the per-tensor trust ratio
 ``||w|| / ||update||``.
 
-TPU: the flat fp32 buffer plus STATIC per-leaf slices lets the
-per-tensor norms be plain reductions (segment_sum / flat-sized gathers
-lower to scatter/gather on TPU and were ~100x slower than the step's
-matmuls) — the whole two-phase step stays one fused XLA program.
+TPU: leaf-wise over the param pytree — the per-tensor trust-ratio norms
+are each leaf's own reduction, and the global grad norm is a tree-wide
+sum of squares. (Earlier designs: segment_sum / flat-sized gathers lower
+to scatter/gather on TPU and were ~100x slower than the step's matmuls;
+a flat buffer with static per-leaf slices fixed that but doubled the
+optimizer's HBM traffic through pack/unpack round trips — see base.py.)
 """
 
 from __future__ import annotations
@@ -19,7 +21,6 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.optimizers.base import FusedOptimizerBase
-from apex_tpu.utils.flat import leaf_slices
 
 
 class FusedLAMB(FusedOptimizerBase):
@@ -38,8 +39,9 @@ class FusedLAMB(FusedOptimizerBase):
         self.use_nvlamb = use_nvlamb
         super().__init__(params, defaults, master_weights=master_weights)
 
-    def _init_slots(self, flat_p32, spec, group):
-        return {"exp_avg": jnp.zeros_like(flat_p32), "exp_avg_sq": jnp.zeros_like(flat_p32)}
+    def _init_slots(self, p32, group):
+        return {"exp_avg": jax.tree.map(jnp.zeros_like, p32),
+                "exp_avg_sq": jax.tree.map(jnp.zeros_like, p32)}
 
     def apply(self, state, params, grads, skip=None, **overrides):
         # Phase 1 (fused_lamb.py:116-143): global grad norm across ALL
@@ -47,32 +49,34 @@ class FusedLAMB(FusedOptimizerBase):
         single = len(self.param_groups) == 1
         glist = [grads] if single else list(grads)
         sq = jnp.asarray(0.0, jnp.float32)
-        for spec, g in zip(self._specs, glist):
-            fg = spec.pack(g, dtype=jnp.float32)
-            sq = sq + jnp.sum(fg * fg)
+        for g in glist:
+            for leaf in jax.tree.leaves(g):
+                leaf = leaf.astype(jnp.float32)
+                sq = sq + jnp.sum(leaf * leaf)
         self._global_grad_norm = jnp.sqrt(sq)
         return super().apply(state, params, grads, skip=skip, **overrides)
 
-    def _update(self, p, g, slots, step, group, spec):
+    def _update(self, p, g, slots, step, group):
         lr = jnp.asarray(group["lr"], jnp.float32)
         beta1, beta2 = group["betas"]
         eps = group["eps"]
         wd = group.get("weight_decay", 0.0)
         max_grad_norm = group.get("max_grad_norm", 0.0)
         grad_averaging = group.get("grad_averaging", True)
-        m, v = slots["exp_avg"], slots["exp_avg_sq"]
 
         # Gradient clipping against the global norm (multi_tensor_lamb.cu
         # clipped_grad = grad / max(1, global_norm / max_grad_norm)).
         if max_grad_norm and max_grad_norm > 0:
             clip = jnp.maximum(1.0, self._global_grad_norm / max_grad_norm)
-            g = g / clip
+            g = jax.tree.map(lambda g: g / clip, g)
 
         # beta3 = 1-beta1 when grad averaging, else 1.0
         # (csrc/multi_tensor_lamb.cu:363-364 semantics)
         beta3 = (1.0 - beta1) if grad_averaging else 1.0
-        m = beta1 * m + beta3 * g
-        v = beta2 * v + (1.0 - beta2) * g * g
+        m = jax.tree.map(lambda m, g: beta1 * m + beta3 * g,
+                         slots["exp_avg"], g)
+        v = jax.tree.map(lambda v, g: beta2 * v + (1.0 - beta2) * g * g,
+                         slots["exp_avg_sq"], g)
 
         if group.get("bias_correction", True):
             stepf = step.astype(jnp.float32)
@@ -81,27 +85,25 @@ class FusedLAMB(FusedOptimizerBase):
         else:
             bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
 
-        update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
-        if wd != 0.0:
-            update = update + wd * p
-
-        # Per-tensor trust ratio via STATIC per-leaf slice reductions.
-        # (segment_sum + a flat-sized ratio gather lower to scatter/gather
-        # on TPU and made a BERT-base LAMB step ~100x slower than the
-        # matmuls; per-leaf slices fuse into plain reductions.)
         # NVLAMB skips the trust ratio for tensors excluded from decay when
         # use_nvlamb=False (fused_lamb.py use_nvlamb flag; here wd is
         # per-group so the per-tensor condition reduces to the norms check).
         use_ratio = self.use_nvlamb or wd != 0.0
-        parts = []
-        for p_i, u_i in zip(leaf_slices(p, spec), leaf_slices(update, spec)):
+
+        def leaf(p, m, v):
+            update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if wd != 0.0:
+                update = update + wd * p
             if use_ratio:
-                w_n = jnp.sqrt(jnp.sum(p_i * p_i))
-                u_n = jnp.sqrt(jnp.sum(u_i * u_i))
+                # per-tensor trust ratio ||w|| / ||update|| — each leaf's
+                # own reduction (multi_tensor_lamb.cu phase 2)
+                w_n = jnp.sqrt(jnp.sum(p * p))
+                u_n = jnp.sqrt(jnp.sum(update * update))
                 ratio = jnp.where((w_n > 0) & (u_n > 0),
                                   w_n / jnp.maximum(u_n, 1e-30), 1.0)
             else:
                 ratio = jnp.asarray(1.0, jnp.float32)
-            parts.append(p_i - lr * ratio * u_i)
-        new_p = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+            return p - lr * ratio * update
+
+        new_p = jax.tree.map(leaf, p, m, v)
         return new_p, {"exp_avg": m, "exp_avg_sq": v}

@@ -6,10 +6,10 @@ tensor* (EMA of the squared grad norm), the first moment is
 ``m = β1·m + g/√(v)+ε (+ wd·p)``, with options ``reg_inside_moment``,
 ``grad_averaging``, ``norm_type`` (0=inf, 2=L2) and ``init_zero``.
 
-TPU: per-tensor norms via STATIC per-leaf slice reductions over the flat
-buffer (segment_sum/gather lower poorly on TPU — see FusedLAMB); moments
-stay flat; the per-tensor scalar v is a small vector expanded back by
-per-leaf scaling.
+TPU: leaf-wise over the param pytree — each tensor's norm is its leaf's
+own reduction and the per-tensor scalar ``v`` is a pytree of fp32
+scalars mirroring the param structure (see FusedLAMB / base.py for the
+segment_sum-vs-slices-vs-leaf-wise history).
 """
 
 from __future__ import annotations
@@ -18,7 +18,6 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.optimizers.base import FusedOptimizerBase
-from apex_tpu.utils.flat import leaf_slices
 
 
 class FusedNovoGrad(FusedOptimizerBase):
@@ -39,48 +38,61 @@ class FusedNovoGrad(FusedOptimizerBase):
         self.init_zero = init_zero
         super().__init__(params, defaults, master_weights=master_weights)
 
-    def _init_slots(self, flat_p32, spec, group):
-        n = len(spec.sizes)
+    def _init_slots(self, p32, group):
         return {
-            "exp_avg": jnp.zeros_like(flat_p32),
+            "exp_avg": jax.tree.map(jnp.zeros_like, p32),
             # per-tensor scalar second moment (fused_novograd.py:148-160)
-            "exp_avg_sq": jnp.zeros((n,), jnp.float32),
+            "exp_avg_sq": jax.tree.map(
+                lambda _: jnp.zeros((), jnp.float32), p32),
             "initialized": jnp.asarray(False),
         }
 
-    def _tensor_norms(self, g_parts):
+    def _tensor_norm(self, g):
         if self.norm_type == 2:
-            return jnp.stack([jnp.sqrt(jnp.sum(gi * gi)) for gi in g_parts])
-        return jnp.stack([jnp.max(jnp.abs(gi)) for gi in g_parts])
+            return jnp.sqrt(jnp.sum(g * g))
+        return jnp.max(jnp.abs(g))
 
-    def _update(self, p, g, slots, step, group, spec):
+    def _update(self, p, g, slots, step, group):
         lr = jnp.asarray(group["lr"], jnp.float32)
         beta1, beta2 = group["betas"]
         eps = group["eps"]
         wd = group.get("weight_decay", 0.0)
         grad_averaging = group.get("grad_averaging", True)
-        m, v, inited = slots["exp_avg"], slots["exp_avg_sq"], slots["initialized"]
+        inited = slots["initialized"]
 
-        g_parts = leaf_slices(g, spec)
-        g_norm = self._tensor_norms(g_parts)
-        # init_zero=False: first step seeds v with ||g||² (fused_novograd.py:151-158)
-        v_seed = jnp.zeros_like(g_norm) if self.init_zero else g_norm * g_norm if self.norm_type == 2 else g_norm
-        v_next = jnp.where(inited, beta2 * v + (1.0 - beta2) * (g_norm * g_norm if self.norm_type == 2 else g_norm), v_seed)
-        denom_t = jnp.sqrt(v_next) if self.norm_type == 2 else v_next
+        def v_leaf(v, g):
+            g_norm = self._tensor_norm(g)
+            gn2 = g_norm * g_norm if self.norm_type == 2 else g_norm
+            # init_zero=False: first step seeds v with ||g||^2
+            # (fused_novograd.py:151-158)
+            v_seed = jnp.zeros_like(gn2) if self.init_zero else gn2
+            return jnp.where(inited, beta2 * v + (1.0 - beta2) * gn2, v_seed)
 
-        g_scaled = jnp.concatenate(
-            [gi / (denom_t[i] + eps) for i, gi in enumerate(g_parts)]
-        ) if len(g_parts) > 1 else g_parts[0] / (denom_t[0] + eps)
-        if wd != 0.0 and self.moment_mode == 0:
-            g_scaled = g_scaled + wd * p  # reg inside moment
+        v_next = jax.tree.map(v_leaf, slots["exp_avg_sq"], g)
+
         beta1_eff = (1.0 - beta1) if grad_averaging else 1.0
-        m = beta1 * m + beta1_eff * g_scaled
 
-        update = m
-        if wd != 0.0 and self.moment_mode == 1:
-            update = update + wd * p
+        def m_leaf(m, g, v, p):
+            denom = jnp.sqrt(v) if self.norm_type == 2 else v
+            g_scaled = g / (denom + eps)
+            if wd != 0.0 and self.moment_mode == 0:
+                g_scaled = g_scaled + wd * p  # reg inside moment
+            return beta1 * m + beta1_eff * g_scaled
+
+        m = jax.tree.map(m_leaf, slots["exp_avg"], g, v_next, p)
+
         if group.get("bias_correction", True):
             stepf = step.astype(jnp.float32)
             bc1 = 1.0 - jnp.power(beta1, stepf)
-            update = update / bc1
-        return p - lr * update, {"exp_avg": m, "exp_avg_sq": v_next, "initialized": jnp.asarray(True)}
+        else:
+            bc1 = jnp.asarray(1.0, jnp.float32)
+
+        def p_leaf(p, m):
+            update = m
+            if wd != 0.0 and self.moment_mode == 1:
+                update = update + wd * p
+            return p - lr * (update / bc1)
+
+        new_p = jax.tree.map(p_leaf, p, m)
+        return new_p, {"exp_avg": m, "exp_avg_sq": v_next,
+                       "initialized": jnp.asarray(True)}

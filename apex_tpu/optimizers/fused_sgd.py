@@ -6,12 +6,13 @@ decay semantics identical to ``torch.optim.SGD``, applied across the whole
 param list in one launch, with ``materialize_master_grads`` and fp16-out
 support for the amp O2 path (``fused_sgd.py:79-104``).
 
-TPU: one fused elementwise update over a single fp32 flat buffer per param
-group; master-weight/half-out handling comes from the base class.
+TPU: fused elementwise fp32 update, leaf-wise over the param pytree;
+master-weight/half-out handling comes from the base class.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from apex_tpu.optimizers.base import FusedOptimizerBase
@@ -32,12 +33,13 @@ class FusedSGD(FusedOptimizerBase):
         self.materialize_master_grads = materialize_master_grads
         super().__init__(params, defaults, master_weights=master_weights)
 
-    def _init_slots(self, flat_p32, spec, group):
+    def _init_slots(self, p32, group):
         if group.get("momentum", 0.0) != 0.0:
-            return {"momentum_buffer": jnp.zeros_like(flat_p32), "initialized": jnp.asarray(False)}
+            return {"momentum_buffer": jax.tree.map(jnp.zeros_like, p32),
+                    "initialized": jnp.asarray(False)}
         return {}
 
-    def _update(self, p, g, slots, step, group, spec):
+    def _update(self, p, g, slots, step, group):
         lr = jnp.asarray(group["lr"], jnp.float32)
         momentum = group.get("momentum", 0.0)
         dampening = group.get("dampening", 0.0)
@@ -45,16 +47,19 @@ class FusedSGD(FusedOptimizerBase):
         nesterov = group.get("nesterov", False)
 
         if wd != 0.0 and not self.wd_after_momentum:
-            g = g + wd * p
+            g = jax.tree.map(lambda g, p: g + wd * p, g, p)
         if momentum != 0.0:
-            buf = slots["momentum_buffer"]
             init = slots["initialized"]
             # torch SGD semantics: first touch sets buf = g (no dampening).
-            new_buf = jnp.where(init, momentum * buf + (1.0 - dampening) * g, g)
-            d = (g + momentum * new_buf) if nesterov else new_buf
+            new_buf = jax.tree.map(
+                lambda buf, g: jnp.where(
+                    init, momentum * buf + (1.0 - dampening) * g, g),
+                slots["momentum_buffer"], g)
+            d = (jax.tree.map(lambda g, b: g + momentum * b, g, new_buf)
+                 if nesterov else new_buf)
             slots = {"momentum_buffer": new_buf, "initialized": jnp.asarray(True)}
         else:
             d = g
         if wd != 0.0 and self.wd_after_momentum:
-            d = d + wd * p
-        return p - lr * d, slots
+            d = jax.tree.map(lambda d, p: d + wd * p, d, p)
+        return jax.tree.map(lambda p, d: p - lr * d, p, d), slots

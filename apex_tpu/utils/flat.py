@@ -61,13 +61,6 @@ class FlatBuffer:
         return jax.tree.unflatten(self.treedef, leaves)
 
 
-def leaf_slices(flat: jax.Array, spec: "FlatBuffer") -> list[jax.Array]:
-    """Static per-leaf views of a flat buffer (shared by the fused
-    optimizers' per-tensor reductions)."""
-    return [jax.lax.slice_in_dim(flat, off, off + size)
-            for off, size in zip(spec.offsets, spec.sizes)]
-
-
 def flatten_tensors(tensors: Sequence[jax.Array]) -> jax.Array:
     """``apex_C.flatten`` equivalent: list of arrays -> one 1-D array."""
     return jnp.concatenate([t.reshape(-1) for t in tensors])

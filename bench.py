@@ -245,6 +245,7 @@ def main():
                 extras["gpt_mfu"] = round(gpt_mfu, 4)
         except Exception as e:
             extras["gpt_error"] = f"{type(e).__name__}: {e}"[:120]
+        import jax
         print(json.dumps({
             "metric": "resnet50_O2_train_throughput",
             "value": round(o2_ips, 2),
@@ -252,6 +253,7 @@ def main():
             "vs_baseline": round(o2_ips / o0_ips, 3),
             "o0_imgs_per_sec": round(o0_ips, 2),
             "o2_step_ms": round(o2_dt * 1e3, 2),
+            "device": getattr(jax.devices()[0], "device_kind", "unknown"),
             **extras,
         }))
     except Exception as e:  # still emit the contract line on failure

@@ -227,14 +227,14 @@ def test_master_weights_half_params():
     params = {"w": jnp.full((8,), 1.0, jnp.bfloat16)}
     opt = FusedAdam(params, lr=1e-3, master_weights=True)
     state = opt.init()
-    assert state.groups[0].master.dtype == jnp.float32
+    assert state.groups[0].master["w"].dtype == jnp.float32
     g = {"w": jnp.full((8,), 0.001, jnp.bfloat16)}
     cur, state = opt.apply(state, params, g)
     assert cur["w"].dtype == jnp.bfloat16
     # master accumulates updates below bf16 resolution
     for _ in range(3):
         cur, state = opt.apply(state, cur, g)
-    assert float(state.groups[0].master[0]) < 1.0
+    assert float(state.groups[0].master["w"][0]) < 1.0
 
 
 def test_lamb_hlo_has_no_flat_sized_constant():
