@@ -62,6 +62,26 @@ def unscale(grads: Any, state: ScalerState, out_dtype=jnp.float32):
     return out, found_inf
 
 
+def sync_found_inf(found_inf: jax.Array, *axis_names: str) -> jax.Array:
+    """OR the overflow flag across model-parallel mesh axes.
+
+    Under tensor (or any model) parallelism each rank sees only its own
+    gradient shards, so ranks can disagree on ``found_inf``; if one rank
+    skips the step while another applies it, the replicated params, step
+    counters, and scaler state diverge permanently. Megatron all-reduces
+    the overflow flag over the model-parallel group before the skip
+    decision — call this with every mesh axis that shards gradients
+    (NOT the data axis: grads are summed over dp before unscale, which
+    already propagates inf). Unbound axis names are ignored, so the same
+    train step works at tp=1 outside shard_map.
+    """
+    from apex_tpu.transformer import parallel_state as _ps  # lazy: no cycle
+    x = found_inf.astype(jnp.int32)
+    for ax in axis_names:
+        x = _ps.psum_if_bound(x, ax)
+    return x > 0
+
+
 def update(
     state: ScalerState,
     found_inf: jax.Array,

@@ -120,8 +120,8 @@ class BertLayer(nn.Module):
     def __call__(self, x, pad_mask):
         cfg = self.cfg
         a = BertSelfAttention(cfg, name="attn")(x, pad_mask)
-        x = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln1")(
-            (x + a).astype(jnp.float32)).astype(cfg.dtype)
+        x = FusedLayerNorm(normalized_shape=cfg.hidden_size, dtype=cfg.dtype,
+                           name="ln1")(x + a)
         sp = ps.sequence_parallel_active(cfg.sequence_parallel)
         y = ColumnParallelLinear(
             input_size=cfg.hidden_size, output_size=cfg.ffn,
@@ -132,8 +132,8 @@ class BertLayer(nn.Module):
             input_size=cfg.ffn, output_size=cfg.hidden_size,
             input_is_parallel=True, sequence_parallel=sp, sequence_dim=1,
             name="fc2")(y)
-        return FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln2")(
-            (x + y).astype(jnp.float32)).astype(cfg.dtype)
+        return FusedLayerNorm(normalized_shape=cfg.hidden_size, dtype=cfg.dtype,
+                              name="ln2")(x + y)
 
 
 class Bert(nn.Module):
@@ -160,8 +160,8 @@ class Bert(nn.Module):
                 x = x + tok_type[0].astype(cfg.dtype)
             else:
                 x = x + jnp.take(tok_type, type_ids, axis=0).astype(cfg.dtype)
-        x = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln_emb")(
-            x.astype(jnp.float32)).astype(cfg.dtype)
+        x = FusedLayerNorm(normalized_shape=cfg.hidden_size, dtype=cfg.dtype,
+                           name="ln_emb")(x)
         sp = ps.sequence_parallel_active(cfg.sequence_parallel)
         if sp:
             tp = ps.get_tensor_model_parallel_world_size()
@@ -183,8 +183,8 @@ class Bert(nn.Module):
             gather_output=True, sequence_parallel=sp, sequence_dim=1,
             name="mlm_dense")(x)
         x = jax.nn.gelu(x.astype(jnp.float32), approximate=True)
-        x = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="mlm_ln")(
-            x).astype(cfg.dtype)
+        x = FusedLayerNorm(normalized_shape=cfg.hidden_size, dtype=cfg.dtype,
+                           name="mlm_ln")(x)
         if ps.get_tensor_model_parallel_world_size() > 1:
             # Megatron "f" before the tied output embedding: bwd
             # all-reduces the per-vocab-shard partial d(x) (see gpt.py)

@@ -169,12 +169,14 @@ class GPTBlock(nn.Module):
                     y, rng=key)
             return y
 
-        h = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln1")(
-            x.astype(jnp.float32)).astype(cfg.dtype)
+        # dtype=cfg.dtype: bf16 in -> bf16 out, fp32 params + fp32 math
+        # inside the kernel (casting here would materialize fp32 copies)
+        h = FusedLayerNorm(normalized_shape=cfg.hidden_size, dtype=cfg.dtype,
+                           name="ln1")(x)
         x = x + hdrop(ParallelSelfAttention(cfg, name="attn")(
             h, deterministic=deterministic))
-        h = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln2")(
-            x.astype(jnp.float32)).astype(cfg.dtype)
+        h = FusedLayerNorm(normalized_shape=cfg.hidden_size, dtype=cfg.dtype,
+                           name="ln2")(x)
         return x + hdrop(ParallelMLP(cfg, name="mlp")(h))
 
 
@@ -207,8 +209,8 @@ class GPT(nn.Module):
                      if cfg.remat_blocks else GPTBlock)
         for i in range(cfg.num_layers):
             x = block_cls(cfg, name=f"block_{i}")(x, deterministic)
-        x = FusedLayerNorm(normalized_shape=cfg.hidden_size, name="ln_f")(
-            x.astype(jnp.float32)).astype(cfg.dtype)
+        x = FusedLayerNorm(normalized_shape=cfg.hidden_size, dtype=cfg.dtype,
+                           name="ln_f")(x)
         if sp:
             x = tp_mappings.gather_from_sequence_parallel_region(
                 x, ps.TENSOR_AXIS, 1)

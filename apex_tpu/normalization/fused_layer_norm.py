@@ -2,8 +2,9 @@
 
 Reference: ``apex/normalization/fused_layer_norm.py:102-219`` —
 ``FusedLayerNorm`` mirrors ``torch.nn.LayerNorm`` backed by the fused
-kernel (CPU fallback to unfused math, :147-151 — here the jnp path *is*
-the fallback and the Pallas path the fast one, chosen inside the op);
+kernel (CPU fallback to unfused math, :147-151 — here the jnp form under
+jit IS the fused form; a hand-written Pallas LN measured no faster, see
+``ops/layer_norm.py``);
 ``MixedFusedLayerNorm`` (:202) keeps params in the input dtype so output
 dtype == param dtype (Megatron-compatible).
 """
@@ -34,6 +35,10 @@ class FusedLayerNorm(nn.Module):
     eps: float = 1e-5
     elementwise_affine: bool = True
     param_dtype: jnp.dtype = jnp.float32
+    # output dtype override; None = param_dtype. Set to the compute dtype
+    # (e.g. bf16) to get bf16 in -> bf16 out with fp32 params and no
+    # call-site casts.
+    dtype: jnp.dtype | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -43,8 +48,10 @@ class FusedLayerNorm(nn.Module):
                 "weight", nn.initializers.ones, shape, self.param_dtype)
             bias = self.param(
                 "bias", nn.initializers.zeros, shape, self.param_dtype)
-            return fused_layer_norm_affine(x, weight, bias, shape, self.eps)
-        return fused_layer_norm(x, shape, self.eps)
+            return fused_layer_norm_affine(x, weight, bias, shape, self.eps,
+                                           self.dtype)
+        y = fused_layer_norm(x, shape, self.eps)
+        return y if self.dtype is None else y.astype(self.dtype)
 
 
 class MixedFusedLayerNorm(FusedLayerNorm):

@@ -99,6 +99,10 @@ def main():
         grads = tp_mappings.allreduce_sequence_parallel_gradients(
             grads, GPT.sequence_parallel_grad_filter)
         grads, found_inf = scaler_mod.unscale(grads, sstate)
+        # tp ranks see different grad shards and must agree on skip-vs-
+        # apply, or replicated state diverges (Megatron's model-parallel
+        # found_inf all-reduce)
+        found_inf = scaler_mod.sync_found_inf(found_inf, ps.TENSOR_AXIS)
         new_vars, new_opt = opt.apply(opt_state, variables, grads,
                                       skip=found_inf)
         new_sstate = scaler_mod.update(sstate, found_inf, dynamic=True)
@@ -123,7 +127,7 @@ def main():
             first = float(loss)
         if step % 10 == 0 or step == args.steps - 1:
             print(f"step {step:4d}  loss {float(loss):.4f}  "
-                  f"scale {float(jax.device_get(jax.tree.leaves(sstate)[0])):g}")
+                  f"scale {float(sstate.loss_scale):g}")
     last = float(loss)
 
     # fp32 checkpoint round trip (O2StateDictHook analog): export master,

@@ -43,6 +43,40 @@ def test_layer_norm_affine_parity(shape):
         np.testing.assert_allclose(np.asarray(a), np.asarray(r), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_layer_norm_affine_large_h_parity(dtype):
+    """Transformer-sized h with bf16 inputs: fwd + all three grads match
+    the naive composition (covers the bf16-in path models use)."""
+    rng = np.random.RandomState(7)
+    shape = (3, 16, 256)
+    x = jnp.asarray(rng.randn(*shape), dtype)
+    w = jnp.asarray(rng.randn(shape[-1]), jnp.float32)
+    b = jnp.asarray(rng.randn(shape[-1]), jnp.float32)
+
+    y = fused_layer_norm_affine(x, w, b, (shape[-1],), 1e-5)
+    ref = _naive_ln(x.astype(jnp.float32), w, b, 1e-5)
+    tol = dict(rtol=1e-5, atol=1e-5) if dtype == jnp.float32 else \
+        dict(rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(y, np.float32), np.asarray(ref), **tol)
+
+    f1 = lambda x, w, b: jnp.sum(
+        fused_layer_norm_affine(x, w, b, (shape[-1],), 1e-5)
+        .astype(jnp.float32) ** 2)
+    f2 = lambda x, w, b: jnp.sum(_naive_ln(x.astype(jnp.float32), w, b, 1e-5) ** 2)
+    g1 = jax.grad(f1, argnums=(0, 1, 2))(x, w, b)
+    g2 = jax.grad(f2, argnums=(0, 1, 2))(x, w, b)
+    for a, r in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(r), **tol)
+
+    # out_dtype override: bf16 in -> bf16 out with fp32 params, values
+    # equal to the fp32 output rounded
+    y16 = fused_layer_norm_affine(x, w, b, (shape[-1],), 1e-5, jnp.bfloat16)
+    assert y16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(y16, np.float32),
+                               np.asarray(ref.astype(jnp.bfloat16), np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
 def test_layer_norm_no_affine_grad():
     rng = np.random.RandomState(1)
     x = jnp.asarray(rng.randn(6, 24), jnp.float32)

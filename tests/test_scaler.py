@@ -66,3 +66,32 @@ def test_stateful_wrapper_and_checkpoint():
     sc2 = S.LossScaler("dynamic")
     sc2.load_state_dict(sd)
     assert sc2.loss_scale() == 128.0
+
+
+def test_sync_found_inf_across_tp():
+    """tp ranks see different grad shards; sync_found_inf must make them
+    agree on skip-vs-apply (one rank's inf flags the whole group)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    from apex_tpu.transformer import parallel_state as ps
+
+    ps.destroy_model_parallel()
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size_=4,
+                                        devices=jax.devices()[:4])
+
+    def f():
+        rank = ps.get_tensor_model_parallel_rank()
+        # only rank 0's shard overflows
+        g = jnp.where(rank == 0, jnp.inf, 1.0)
+        local_found = ~jnp.isfinite(g)
+        return S.sync_found_inf(local_found, ps.TENSOR_AXIS).reshape(1)
+
+    out = jax.jit(shard_map(
+        f, mesh=mesh, in_specs=(), out_specs=P(ps.TENSOR_AXIS),
+        check_vma=False))()
+    assert np.asarray(out).all(), out  # every rank skips
+
+    # unbound axis (tp=1 path, outside shard_map): no-op
+    assert not bool(S.sync_found_inf(jnp.asarray(False), ps.TENSOR_AXIS))
+    ps.destroy_model_parallel()
