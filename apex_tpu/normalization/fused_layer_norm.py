@@ -64,8 +64,10 @@ class MixedFusedLayerNorm(FusedLayerNorm):
         shape = _as_shape(self.normalized_shape)
         weight = self.param("weight", nn.initializers.ones, shape, self.param_dtype)
         bias = self.param("bias", nn.initializers.zeros, shape, self.param_dtype)
+        # inherited `dtype` still overrides the output (x.dtype otherwise)
         return fused_layer_norm_affine(
-            x, weight.astype(x.dtype), bias.astype(x.dtype), shape, self.eps)
+            x, weight.astype(x.dtype), bias.astype(x.dtype), shape, self.eps,
+            self.dtype)
 
 
 class FusedRMSNorm(nn.Module):

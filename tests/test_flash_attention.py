@@ -281,3 +281,26 @@ def test_flash_backward_memory_flat_in_seqlen():
     big = biggest_intermediate(1024)
     # O(s): 4x seqlen -> ~4x biggest buffer. An O(s^2) backward would be 16x.
     assert big <= small * 6, (small, big)
+
+
+def test_bwd_two_kernel_fallback_matches_fused(monkeypatch):
+    """Long-sequence fallback (two-kernel flash-attention-2 backward) and
+    the fused single-pass backward must produce identical gradients."""
+    import importlib
+    fa = importlib.import_module("apex_tpu.ops.flash_attention")
+    rng = np.random.RandomState(11)
+    b, h, s, d = 1, 2, 256, 32
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       block_q=128, block_k=128) ** 2)
+
+    g_fused = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setattr(fa, "_FUSED_BWD_MAX_KV_BYTES", 0)
+    g_two = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for a, r in zip(g_fused, g_two):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-5, atol=1e-5)
