@@ -218,6 +218,51 @@ def _bench_gpt():
     return b * s / dt, mfu
 
 
+def _bench_bert():
+    """BERT-base + FusedLAMB full train step (BASELINE config 4: the
+    apex BERT+LAMB recipe). Returns (tok/s, mfu|None)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from apex_tpu.models.bert import Bert, BertConfig
+    from apex_tpu.optimizers import FusedLAMB
+    from apex_tpu.transformer import parallel_state as ps
+    from apex_tpu.transformer.tensor_parallel import (
+        vocab_parallel_cross_entropy)
+
+    ps.destroy_model_parallel()
+    b, s = 16, 512
+    model = Bert(BertConfig(dtype=jnp.bfloat16))
+    rng = np.random.RandomState(1)
+    ids = jnp.asarray(rng.randint(0, 30000, (b, s)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, 30000, (b, s)), jnp.int32)
+    v = model.init(jax.random.PRNGKey(0), ids)
+    opt = FusedLAMB(lr=1e-3)
+    state = opt.init(v)
+
+    @jax.jit
+    def step(v, state, ids, labels):
+        def loss_fn(v):
+            logits = model.apply(v, ids)
+            return jnp.mean(vocab_parallel_cross_entropy(logits, labels))
+        loss, g = jax.value_and_grad(loss_fn)(v)
+        v2, s2 = opt.apply(state, v, g)
+        return loss, v2, s2
+
+    flops = _step_flops(step, v, state, ids, labels)
+    loss, v, state = step(v, state, ids, labels)
+    float(loss)
+    n = 10
+    t0 = time.perf_counter()
+    for _ in range(n):
+        loss, v, state = step(v, state, ids, labels)
+    float(loss)
+    dt = (time.perf_counter() - t0) / n
+    peak = _peak_flops()
+    mfu = flops / dt / peak if (flops and peak) else None
+    return b * s / dt, mfu
+
+
 def main():
     try:
         o2_ips, o2_dt, o2_flops = _time_steps("O2", want_flops=True)
@@ -245,6 +290,13 @@ def main():
                 extras["gpt_mfu"] = round(gpt_mfu, 4)
         except Exception as e:
             extras["gpt_error"] = f"{type(e).__name__}: {e}"[:120]
+        try:
+            bert_tps, bert_mfu = _bench_bert()
+            extras["bert_tokens_per_sec"] = round(bert_tps, 1)
+            if bert_mfu:
+                extras["bert_mfu"] = round(bert_mfu, 4)
+        except Exception as e:
+            extras["bert_error"] = f"{type(e).__name__}: {e}"[:120]
         import jax
         print(json.dumps({
             "metric": "resnet50_O2_train_throughput",

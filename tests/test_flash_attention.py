@@ -283,9 +283,12 @@ def test_flash_backward_memory_flat_in_seqlen():
     assert big <= small * 6, (small, big)
 
 
-def test_bwd_two_kernel_fallback_matches_fused(monkeypatch):
+@pytest.mark.parametrize("features", ["plain", "dropout", "seg_bias"])
+def test_bwd_two_kernel_fallback_matches_fused(monkeypatch, features):
     """Long-sequence fallback (two-kernel flash-attention-2 backward) and
-    the fused single-pass backward must produce identical gradients."""
+    the fused single-pass backward must produce identical gradients —
+    including the feature wiring (dropout key plumbing; the dkdv kernel's
+    swapped qdim/kdim specs for segment-ids and bias)."""
     import importlib
     fa = importlib.import_module("apex_tpu.ops.flash_attention")
     rng = np.random.RandomState(11)
@@ -293,10 +296,17 @@ def test_bwd_two_kernel_fallback_matches_fused(monkeypatch):
     q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
     k = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
     v = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    kw = dict(causal=True, block_q=128, block_k=128)
+    if features == "dropout":
+        kw.update(dropout_rate=0.3, dropout_seed=17)
+    elif features == "seg_bias":
+        sid = jnp.asarray(rng.randint(0, 3, (b, s)).cumsum(-1) // 2,
+                          jnp.int32)  # non-trivial monotone segments
+        bias = jnp.asarray(rng.randn(1, 1, s, s) * 0.2, jnp.float32)
+        kw.update(segment_ids_q=sid, bias=bias)
 
     def loss(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal=True,
-                                       block_q=128, block_k=128) ** 2)
+        return jnp.sum(flash_attention(q, k, v, **kw) ** 2)
 
     g_fused = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     monkeypatch.setattr(fa, "_FUSED_BWD_MAX_KV_BYTES", 0)
