@@ -181,6 +181,25 @@ def _bench_fused_adam():
     return dt_eager / dt_fused, dt_fused, dt_eager
 
 
+def _time_train_step(step, args, tokens, n=10):
+    """Time a jitted train step whose first output is the loss scalar:
+    one warm call, then n timed calls chained through carried state where
+    the caller rebinds, with the scalar host transfer as the full-chain
+    device sync (the async-dispatch rule from the module docstring lives
+    HERE and only here). Returns (tokens_per_sec, mfu|None)."""
+    flops = _step_flops(step, *args)
+    out = step(*args)
+    float(out[0])
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = step(*args)
+    float(out[0])
+    dt = (time.perf_counter() - t0) / n
+    peak = _peak_flops()
+    mfu = flops / dt / peak if (flops and peak) else None
+    return tokens / dt, mfu
+
+
 def _bench_gpt():
     """GPT train-step throughput (BASELINE config 5: apex.transformer GPT
     with the Pallas flash-attention path). Returns (tok/s, mfu|None)."""
@@ -204,18 +223,7 @@ def _bench_gpt():
     def step(v, ids, labels):
         return jax.value_and_grad(lambda v: model.loss(v, ids, labels))(v)
 
-    flops = _step_flops(step, v, ids, labels)
-    loss, grads = step(v, ids, labels)
-    float(loss)
-    n = 10
-    t0 = time.perf_counter()
-    for _ in range(n):
-        loss, grads = step(v, ids, labels)
-    float(loss)
-    dt = (time.perf_counter() - t0) / n
-    peak = _peak_flops()
-    mfu = flops / dt / peak if (flops and peak) else None
-    return b * s / dt, mfu
+    return _time_train_step(step, (v, ids, labels), b * s)
 
 
 def _bench_bert():
@@ -249,18 +257,7 @@ def _bench_bert():
         v2, s2 = opt.apply(state, v, g)
         return loss, v2, s2
 
-    flops = _step_flops(step, v, state, ids, labels)
-    loss, v, state = step(v, state, ids, labels)
-    float(loss)
-    n = 10
-    t0 = time.perf_counter()
-    for _ in range(n):
-        loss, v, state = step(v, state, ids, labels)
-    float(loss)
-    dt = (time.perf_counter() - t0) / n
-    peak = _peak_flops()
-    mfu = flops / dt / peak if (flops and peak) else None
-    return b * s / dt, mfu
+    return _time_train_step(step, (v, state, ids, labels), b * s)
 
 
 def main():

@@ -107,6 +107,53 @@ def main():
         lambda v, x: bn.apply(v, x, use_running_average=False,
                               mutable=["batch_stats"]))(vb, xb))
 
+    def _fp16_o2_steps():
+        """True-fp16 amp O2 with dynamic loss scaling end-to-end: the
+        half dtype TPUs don't natively prefer still must train (loss
+        scaling is pointless in bf16, so fp16 is its real hardware test)."""
+        from apex_tpu import amp
+        from apex_tpu.amp import scaler as S
+        from apex_tpu.optimizers import FusedSGD
+        from apex_tpu.models import ResNet18
+        from apex_tpu.ops import softmax_cross_entropy_with_smoothing
+
+        model = ResNet18(num_classes=10, dtype=jnp.float16)
+        amp_model, opt = amp.initialize(
+            lambda v, x: model.apply(v, x, train=True,
+                                     mutable=["batch_stats"]),
+            FusedSGD(lr=0.01, momentum=0.9), opt_level="O2",
+            half_dtype=jnp.float16, loss_scale="dynamic", verbosity=0)
+        # lr matters here: too-aggressive steps blow fp16 *forward*
+        # activations to inf (loss scaling only protects gradients)
+        x = jax.random.normal(key, (32, 32, 32, 3), jnp.float32)
+        y = jax.random.randint(key, (32,), 0, 10)
+        v = amp_model.cast_params(model.init(key, x[:2], train=True))
+        opt_state = opt.init(v["params"])
+        scaler = opt._amp_stash.loss_scalers[0]
+
+        @jax.jit
+        def step(params, stats, opt_state, sstate, x, y):
+            def loss_fn(p):
+                out, upd = amp_model({"params": p, "batch_stats": stats}, x)
+                l = jnp.mean(softmax_cross_entropy_with_smoothing(out, y, 0.0))
+                return S.scale_value(l, sstate), (l, upd["batch_stats"])
+            g, (l, st) = jax.grad(loss_fn, has_aux=True)(params)
+            g, found = S.unscale(g, sstate)
+            p2, o2 = opt.apply(opt_state, params, g, skip=found)
+            return p2, st, o2, scaler.update_state(sstate, found), l
+
+        params, stats, sstate = v["params"], v["batch_stats"], scaler.state
+        first = last = None
+        for _ in range(8):
+            params, stats, opt_state, sstate, l = step(
+                params, stats, opt_state, sstate, x, y)
+            first = float(l) if first is None else first
+            last = float(l)
+        assert last < first, (first, last)
+        return jnp.asarray(last)
+
+    ok &= _check("amp O2 fp16 + dynamic scaler train", _fp16_o2_steps)
+
     print("SMOKE " + ("PASSED" if ok else "FAILED"))
     return 0 if ok else 1
 
