@@ -5,9 +5,10 @@ declared the ``expert`` mesh axis in ``parallel_state.py`` without any
 layer using it — VERDICT r1 next-round #10). TPU-native design per the
 Mesh-TensorFlow/Switch formulation:
 
-- top-1 router with static **capacity** per expert (static shapes — XLA
-  needs them; dropped tokens pass through with zero contribution, the
-  standard switch residual contract);
+- top-1 (Switch) or top-2 (GShard, pair-renormalized gates) router with
+  static **capacity** per expert (static shapes — XLA needs them;
+  dropped tokens pass through with zero contribution, the standard
+  switch residual contract);
 - dispatch/combine as one-hot einsums (MXU-friendly, no gather/scatter);
 - tokens move to their experts with ONE ``all_to_all`` over the
   ``expert`` axis and back with a second — the EP analog of the
@@ -30,6 +31,20 @@ import jax.numpy as jnp
 from apex_tpu.transformer import parallel_state as ps
 
 
+def _place(one_hot, offset, capacity: int):
+    """Slot each routed token in its expert's buffer (arrival order,
+    starting at ``offset`` per expert); over-capacity tokens drop.
+    one_hot: [t, E]; offset: scalar or [1, E]. Returns [t, E, C]."""
+    pos = jnp.cumsum(one_hot, axis=0) * one_hot - 1.0        # [t, E], -1 if unrouted
+    pos = pos + offset * one_hot
+    keep = (pos >= 0) & (pos < capacity)
+    pos_tok = jnp.sum(jnp.where(keep, pos, 0.0), axis=-1)    # [t]
+    d = jax.nn.one_hot(pos_tok.astype(jnp.int32), capacity,
+                       dtype=jnp.float32)                    # [t, C]
+    d = one_hot[:, :, None] * d[:, None, :]                  # [t, E, C]
+    return d * keep.any(axis=-1)[:, None, None]
+
+
 def top1_routing(logits, capacity: int):
     """Switch top-1 routing with per-expert capacity.
 
@@ -41,15 +56,7 @@ def top1_routing(logits, capacity: int):
     expert_idx = jnp.argmax(probs, axis=-1)                  # [t]
     gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
     one_hot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # [t, E]
-
-    # position of each token within its expert's buffer (arrival order)
-    pos = jnp.cumsum(one_hot, axis=0) * one_hot - 1.0        # [t, E], -1 if unrouted
-    keep = (pos >= 0) & (pos < capacity)
-    pos_tok = jnp.sum(jnp.where(keep, pos, 0.0), axis=-1)    # [t]
-    dispatch = jax.nn.one_hot(pos_tok.astype(jnp.int32), capacity,
-                              dtype=jnp.float32)             # [t, C]
-    dispatch = one_hot[:, :, None] * dispatch[:, None, :]    # [t, E, C]
-    dispatch = dispatch * keep.any(axis=-1)[:, None, None]
+    dispatch = _place(one_hot, 0.0, capacity)
     combine = dispatch * gate[:, None, None]
 
     # switch aux loss: E * sum_e f_e * P_e (fraction routed x mean prob)
@@ -59,16 +66,58 @@ def top1_routing(logits, capacity: int):
     return dispatch, combine, aux
 
 
+def top2_routing(logits, capacity: int):
+    """GShard top-2 routing with per-expert capacity.
+
+    logits: [t, E]. Each token is dispatched to its two highest-prob
+    experts with gates renormalized over the pair (g1 + g2 = 1); the
+    second choice queues BEHIND every first-choice assignment of that
+    expert (the mesh-tf/GShard position rule), so first choices win
+    capacity contention. Returns (dispatch [t, E, C], combine [t, E, C],
+    aux_loss) with the same shapes/contract as :func:`top1_routing`.
+    """
+    t, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx1 = jnp.argmax(probs, axis=-1)                        # [t]
+    oh1 = jax.nn.one_hot(idx1, E, dtype=jnp.float32)
+    probs2 = probs * (1.0 - oh1)
+    idx2 = jnp.argmax(probs2, axis=-1)
+    oh2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32)
+    p1 = jnp.take_along_axis(probs, idx1[:, None], axis=-1)[:, 0]
+    p2 = jnp.take_along_axis(probs, idx2[:, None], axis=-1)[:, 0]
+    # saturated softmax guard: when p1 rounds to 1.0, probs2 is all-zero
+    # and argmax would produce a ghost dispatch to expert 0 with zero
+    # gate, burning a real capacity slot there
+    oh2 = oh2 * (p2 > 0.0)[:, None]
+    denom = jnp.maximum(p1 + p2, 1e-9)
+    g1, g2 = p1 / denom, p2 / denom
+
+    d1 = _place(oh1, 0.0, capacity)
+    d2 = _place(oh2, jnp.sum(oh1, axis=0, keepdims=True),    # behind all top-1
+                capacity)
+    dispatch = d1 + d2
+    combine = d1 * g1[:, None, None] + d2 * g2[:, None, None]
+
+    # aux loss on the FIRST choice only (GShard eq. for l_aux)
+    f = jnp.mean(oh1, axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(f * p)
+    return dispatch, combine, aux
+
+
 def expert_parallel_mlp(x, router_w, wi, wo, *,
                         axis_name: Optional[str] = ps.EXPERT_AXIS,
                         capacity_factor: float = 1.25,
-                        activation: Callable = jax.nn.gelu):
-    """Switch-MoE MLP layer.
+                        activation: Callable = jax.nn.gelu,
+                        num_selected_experts: int = 1):
+    """Switch (top-1) / GShard (top-2) MoE MLP layer.
 
     x: [t, h] local tokens; router_w: [h, E_global] (replicated);
     wi: [E_local, h, f]; wo: [E_local, f, h] (each device holds its local
     experts). Returns (y [t, h], aux_loss). Tokens over capacity produce
     zeros — add the residual outside, per the switch recipe.
+    ``num_selected_experts``: 1 = switch top-1 routing, 2 = GShard top-2
+    with pair-renormalized gates.
     """
     t, h = x.shape
     ep = ps.axis_size_if_bound(axis_name)
@@ -78,12 +127,19 @@ def expert_parallel_mlp(x, router_w, wi, wo, *,
         raise ValueError(
             f"router has {router_w.shape[-1]} experts but wi provides "
             f"{e_local} x ep={ep} = {E}")
-    capacity = max(1, int(capacity_factor * t / E))
+    # capacity scales with the assignments per token (GShard sizes top-2
+    # buffers at 2*cf*t/E — without this, second choices are mostly
+    # dropped at the default capacity_factor)
+    capacity = max(1, int(capacity_factor * num_selected_experts * t / E))
 
+    if num_selected_experts not in (1, 2):
+        raise ValueError(
+            f"num_selected_experts must be 1 or 2, got {num_selected_experts}")
     # router in fp32 (the switch recipe); expert compute stays in x.dtype
     # so bf16 training keeps MXU rate on the FLOPs-dominant einsums
     logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
-    dispatch, combine, aux = top1_routing(logits, capacity)
+    routing = top1_routing if num_selected_experts == 1 else top2_routing
+    dispatch, combine, aux = routing(logits, capacity)
     # aux is computed from local tokens; average over the expert group so
     # every rank carries the same load-balancing scalar when x is sharded
     aux = ps.psum_if_bound(aux, axis_name) / ep
@@ -142,10 +198,12 @@ class ExpertParallelMLP:
 
     def __init__(self, axis_name: Optional[str] = ps.EXPERT_AXIS,
                  capacity_factor: float = 1.25,
-                 activation: Callable = jax.nn.gelu):
+                 activation: Callable = jax.nn.gelu,
+                 num_selected_experts: int = 1):
         self.axis_name = axis_name
         self.capacity_factor = capacity_factor
         self.activation = activation
+        self.num_selected_experts = num_selected_experts
 
     @staticmethod
     def init(key, hidden: int, ffn: int, num_experts: int, ep: int = 1,
@@ -170,4 +228,5 @@ class ExpertParallelMLP:
         return expert_parallel_mlp(
             x, params["router"], params["wi"], params["wo"],
             axis_name=self.axis_name, capacity_factor=self.capacity_factor,
-            activation=self.activation)
+            activation=self.activation,
+            num_selected_experts=self.num_selected_experts)

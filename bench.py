@@ -181,18 +181,26 @@ def _bench_fused_adam():
     return dt_eager / dt_fused, dt_fused, dt_eager
 
 
-def _time_train_step(step, args, tokens, n=10):
-    """Time a jitted train step whose first output is the loss scalar:
-    one warm call, then n timed calls chained through carried state where
-    the caller rebinds, with the scalar host transfer as the full-chain
-    device sync (the async-dispatch rule from the module docstring lives
-    HERE and only here). Returns (tokens_per_sec, mfu|None)."""
+def _time_train_step(step, args, tokens, n=10, rebind=None):
+    """Time a jitted train step whose first output is the loss scalar.
+
+    One warm call, then n timed calls; the final scalar host transfer is
+    the device sync (the async-dispatch rule from the module docstring
+    lives HERE and only here). When the step carries state, pass
+    ``rebind(args, out) -> args`` so successive calls form a true data
+    dependency chain and that last transfer provably fences all n;
+    without carried state the device still executes same-stream programs
+    in launch order. Returns (tokens_per_sec, mfu|None)."""
     flops = _step_flops(step, *args)
     out = step(*args)
     float(out[0])
+    if rebind is not None:
+        args = rebind(args, out)
     t0 = time.perf_counter()
     for _ in range(n):
         out = step(*args)
+        if rebind is not None:
+            args = rebind(args, out)
     float(out[0])
     dt = (time.perf_counter() - t0) / n
     peak = _peak_flops()
@@ -257,7 +265,9 @@ def _bench_bert():
         v2, s2 = opt.apply(state, v, g)
         return loss, v2, s2
 
-    return _time_train_step(step, (v, state, ids, labels), b * s)
+    return _time_train_step(
+        step, (v, state, ids, labels), b * s,
+        rebind=lambda args, out: (out[1], out[2], args[2], args[3]))
 
 
 def main():

@@ -118,3 +118,77 @@ def test_validation():
                             params["wo"], axis_name=None)
     with pytest.raises(ValueError, match="divisible"):
         ExpertParallelMLP.init(jax.random.PRNGKey(0), 8, 16, 5, ep=2)
+
+
+def test_top2_routing_contract():
+    """GShard top-2: two slots per token (capacity permitting), gates
+    renormalized over the selected pair, first choices win contention."""
+    from apex_tpu.transformer.moe import top2_routing
+    rng = np.random.RandomState(5)
+    t, E, C = 16, 4, 16  # capacity = t: no expert can overflow
+    logits = jnp.asarray(rng.randn(t, E), jnp.float32)
+    dispatch, combine, aux = top2_routing(logits, capacity=C)
+    assert dispatch.shape == (t, E, C)
+    # every token lands in exactly two (expert, slot) cells
+    per_token = np.asarray(dispatch.sum(axis=(1, 2)))
+    np.testing.assert_allclose(per_token, 2.0)
+    # pair-renormalized gates sum to 1 per token
+    gate_sum = np.asarray(combine.sum(axis=(1, 2)))
+    np.testing.assert_allclose(gate_sum, 1.0, rtol=1e-5)
+    # no expert exceeds capacity
+    per_expert = np.asarray(dispatch.sum(axis=(0, 2)))
+    assert (per_expert <= C + 1e-6).all()
+    # no two tokens share a slot
+    per_slot = np.asarray(dispatch.sum(axis=0))
+    assert (per_slot <= 1 + 1e-6).all()
+    assert float(aux) > 0
+
+
+def test_top2_expert_parallel_matches_single_device():
+    """ep=4 top-2 (all_to_all dispatch/return) == ep=1 with the same
+    weights, values and gradients."""
+    mesh = _setup(ep=4)
+    h, f, E, t = 16, 32, 8, 64
+    params = _params(jax.random.PRNGKey(7), h, f, E)
+    rng = np.random.RandomState(8)
+    x = jnp.asarray(rng.randn(t, h), jnp.float32)
+
+    def loss_dist(x, router, wi, wo):
+        def inner(x, router, wi, wo):
+            y, aux = expert_parallel_mlp(x, router, wi, wo,
+                                         num_selected_experts=2)
+            return jnp.sum(jnp.tanh(y)) + 0.01 * aux
+        return shard_map(inner, mesh=mesh,
+                         in_specs=(P(), P(), P("expert"), P("expert")),
+                         out_specs=P(), check_vma=False)(x, router, wi, wo)
+
+    def loss_ref(x, router, wi, wo):
+        y, aux = expert_parallel_mlp(x, router, wi, wo, axis_name=None,
+                                     num_selected_experts=2)
+        return jnp.sum(jnp.tanh(y)) + 0.01 * aux
+
+    assert np.isclose(
+        float(loss_dist(x, params["router"], params["wi"], params["wo"])),
+        float(loss_ref(x, params["router"], params["wi"], params["wo"])),
+        rtol=1e-5)
+    g1 = jax.grad(loss_dist, (0, 1, 2, 3))(
+        x, params["router"], params["wi"], params["wo"])
+    g2 = jax.grad(loss_ref, (0, 1, 2, 3))(
+        x, params["router"], params["wi"], params["wo"])
+    for a, r in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-3, atol=1e-4)
+    ps.destroy_model_parallel()
+
+
+def test_top2_beats_top1_capacity_utilization():
+    """With tight capacity, top-2 routes strictly more token-expert
+    assignments than top-1 (second choices fill spare slots)."""
+    from apex_tpu.transformer.moe import top2_routing
+    rng = np.random.RandomState(9)
+    t, E = 64, 4
+    cap = int(1.25 * t / E)
+    logits = jnp.asarray(rng.randn(t, E) * 2, jnp.float32)
+    d1, _, _ = top1_routing(logits, cap)
+    d2, _, _ = top2_routing(logits, cap)
+    assert float(d2.sum()) > float(d1.sum())
