@@ -57,6 +57,30 @@ class GPTConfig:
     # GEMM and Row layers reduce-scatter it back (tensor_parallel layers'
     # sequence_parallel flags with sequence_dim=1 for [b, s, h]).
     sequence_parallel: bool = False
+    # Mixture-of-experts: > 0 replaces the MLP of every ``moe_every``-th
+    # block with an ExpertParallelMLP of this many global experts (local
+    # experts = moe_num_experts / ep over the expert mesh axis; dense
+    # single-device MoE when the axis is unbound). Aux load-balancing
+    # loss is sown as an intermediate and added by ``GPT.loss``.
+    moe_num_experts: int = 0
+    moe_every: int = 2                       # GShard: every other block
+    moe_top_k: int = 2                       # 1 = switch, 2 = GShard
+    moe_capacity_factor: float = 1.25
+    moe_aux_coeff: float = 0.01
+
+    def __post_init__(self):
+        if self.moe_num_experts and self.moe_every < 1:
+            raise ValueError(f"moe_every must be >= 1, got {self.moe_every}")
+        if self.moe_num_experts and self.sequence_parallel:
+            # under SP each tp rank holds different tokens; the MoE
+            # params are replicated over tp, so their grads would need
+            # the SP partial-grad allreduce that the grad filter only
+            # applies to LN/bias leaves — composition deliberately
+            # rejected rather than silently wrong
+            raise ValueError(
+                "moe_num_experts > 0 does not compose with "
+                "sequence_parallel=True (replicated expert params would "
+                "see per-tp-rank token shards)")
 
     @property
     def ffn(self):
@@ -149,8 +173,50 @@ class ParallelMLP(nn.Module):
             name="fc2")(y)
 
 
+class MoEMLP(nn.Module):
+    """Expert-parallel MoE MLP as a GPT block's feed-forward.
+
+    Owns {router, wi, wo} in the param tree and sows the load-balancing
+    aux loss under ``intermediates/moe_aux``. When the ``expert`` mesh
+    axis is bound, wi/wo hold each rank's LOCAL experts: initialize
+    inside ``shard_map`` and re-seed ONLY the wi/wo leaves with an
+    ep-rank-folded key — every other parameter (router, attention,
+    embeddings) is replicated and must be initialized identically on
+    all ranks or the "replicated" state silently diverges.
+    """
+
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        from apex_tpu.transformer.moe import expert_parallel_mlp
+        cfg = self.cfg
+        h = cfg.hidden_size
+        E = cfg.moe_num_experts
+        ep = ps.axis_size_if_bound(ps.EXPERT_AXIS)
+        if E % ep:
+            raise ValueError(f"moe_num_experts {E} not divisible by "
+                             f"expert-parallel size {ep}")
+        e_local = E // ep
+        router = self.param("router", nn.initializers.normal(0.02),
+                            (h, E), jnp.float32)
+        wi = self.param("wi", nn.initializers.variance_scaling(
+            2.0, "fan_in", "normal"), (e_local, h, cfg.ffn), jnp.float32)
+        wo = self.param("wo", nn.initializers.variance_scaling(
+            2.0, "fan_in", "normal"), (e_local, cfg.ffn, h), jnp.float32)
+        b, s, _ = x.shape
+        y, aux = expert_parallel_mlp(
+            x.reshape(b * s, h), router, wi.astype(cfg.dtype),
+            wo.astype(cfg.dtype),
+            capacity_factor=cfg.moe_capacity_factor,
+            num_selected_experts=cfg.moe_top_k)
+        self.sow("intermediates", "moe_aux", aux)
+        return y.reshape(b, s, h)
+
+
 class GPTBlock(nn.Module):
     cfg: GPTConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
@@ -177,7 +243,9 @@ class GPTBlock(nn.Module):
             h, deterministic=deterministic))
         h = FusedLayerNorm(normalized_shape=cfg.hidden_size, dtype=cfg.dtype,
                            name="ln2")(x)
-        return x + hdrop(ParallelMLP(cfg, name="mlp")(h))
+        mlp = (MoEMLP(cfg, name="moe_mlp") if self.use_moe
+               else ParallelMLP(cfg, name="mlp"))
+        return x + hdrop(mlp(h))
 
 
 class GPT(nn.Module):
@@ -208,7 +276,9 @@ class GPT(nn.Module):
         block_cls = (nn.remat(GPTBlock, static_argnums=(2,))
                      if cfg.remat_blocks else GPTBlock)
         for i in range(cfg.num_layers):
-            x = block_cls(cfg, name=f"block_{i}")(x, deterministic)
+            use_moe = bool(cfg.moe_num_experts) and (
+                i % cfg.moe_every == cfg.moe_every - 1)
+            x = block_cls(cfg, use_moe, name=f"block_{i}")(x, deterministic)
         x = FusedLayerNorm(normalized_shape=cfg.hidden_size, dtype=cfg.dtype,
                            name="ln_f")(x)
         if sp:
@@ -226,6 +296,13 @@ class GPT(nn.Module):
         return logits  # [b, s, V/tp] (full V at tp=1)
 
     def loss(self, variables, ids, labels):
+        if self.cfg.moe_num_experts:
+            logits, mut = self.apply(variables, ids,
+                                     mutable=["intermediates"])
+            ce = jnp.mean(vocab_parallel_cross_entropy(logits, labels))
+            auxes = jax.tree.leaves(mut["intermediates"])
+            return ce + self.cfg.moe_aux_coeff * (
+                sum(auxes) / max(len(auxes), 1))
         logits = self.apply(variables, ids)
         losses = vocab_parallel_cross_entropy(logits, labels)
         return jnp.mean(losses)

@@ -84,10 +84,12 @@ def top2_routing(logits, capacity: int):
     idx2 = jnp.argmax(probs2, axis=-1)
     oh2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32)
     p1 = jnp.take_along_axis(probs, idx1[:, None], axis=-1)[:, 0]
-    p2 = jnp.take_along_axis(probs, idx2[:, None], axis=-1)[:, 0]
-    # saturated softmax guard: when p1 rounds to 1.0, probs2 is all-zero
-    # and argmax would produce a ghost dispatch to expert 0 with zero
-    # gate, burning a real capacity slot there
+    # p2 reads the MASKED distribution: for a real second choice it
+    # equals probs[idx2]; when the softmax saturated (p1 -> 1.0, probs2
+    # all-zero) it is exactly 0 whatever expert argmax fell on —
+    # including idx1 itself — so the guard below kills the ghost
+    # dispatch instead of burning a capacity slot
+    p2 = jnp.take_along_axis(probs2, idx2[:, None], axis=-1)[:, 0]
     oh2 = oh2 * (p2 > 0.0)[:, None]
     denom = jnp.maximum(p1 + p2, 1e-9)
     g1, g2 = p1 / denom, p2 / denom
