@@ -42,6 +42,17 @@ import jax.numpy as jnp
 from apex_tpu.utils.tree import tree_all_finite
 
 
+def _cast_fresh(x, dtype):
+    """``astype`` that never aliases. A same-dtype ``astype`` in eager
+    JAX returns the IDENTICAL Array object; master weights and model
+    params must stay distinct buffers, or donating/deleting one kills
+    the other (a donating train step then fails with 'Attempt to donate
+    the same buffer twice' — caught via the imagenet example)."""
+    if x.dtype == dtype:
+        return jnp.array(x, copy=True)
+    return x.astype(dtype)
+
+
 class GroupState(NamedTuple):
     """Per-param-group slice of optimizer state."""
 
@@ -108,8 +119,8 @@ class FusedOptimizerBase:
             self.param_groups[0]["params"] = params
         gs = []
         for group in self.param_groups:
-            p32 = jax.tree.map(lambda x: x.astype(self.master_dtype),
-                               group["params"])
+            p32 = jax.tree.map(
+                lambda x: _cast_fresh(x, self.master_dtype), group["params"])
             master = p32 if self.master_weights else None
             gs.append(GroupState(
                 step=jnp.asarray(0, jnp.int32),
@@ -154,9 +165,10 @@ class FusedOptimizerBase:
             new_groups.append(GroupState(new_step.astype(jnp.int32), master, new_slots))
 
             # model params take each leaf's own dtype (fp32->half downcast in
-            # O2 master mode — _process_optimizer.py:353-364)
+            # O2 master mode — _process_optimizer.py:353-364); _cast_fresh so
+            # an eager apply never returns params aliasing the new master
             new_params.append(jax.tree.map(
-                lambda x, ref: x.astype(ref.dtype), new_p32, p))
+                lambda x, ref: _cast_fresh(x, ref.dtype), new_p32, p))
 
         out_params = new_params[0] if single else new_params
         return out_params, OptimizerState(groups=tuple(new_groups))
@@ -178,7 +190,7 @@ class FusedOptimizerBase:
                  (params or [None] * len(self.param_groups)))):
             if gstate.master is not None:
                 outs.append(jax.tree.map(
-                    lambda x: x.astype(jnp.float32), gstate.master))
+                    lambda x: _cast_fresh(x, jnp.float32), gstate.master))
             elif p is not None:
                 outs.append(jax.tree.map(
                     lambda x: x.astype(jnp.float32)
@@ -200,12 +212,16 @@ class FusedOptimizerBase:
         plist = [fp32_params] if single else list(fp32_params)
         new_params, new_groups = [], []
         for group, gstate, p in zip(self.param_groups, state.groups, plist):
-            p32 = jax.tree.map(lambda x: x.astype(self.master_dtype), p)
+            # _cast_fresh throughout: the restored master must alias
+            # neither the caller's checkpoint arrays nor the returned
+            # model params
+            p32 = jax.tree.map(
+                lambda x: _cast_fresh(x, self.master_dtype), p)
             master = p32 if gstate.master is not None else None
             new_groups.append(GroupState(gstate.step, master, gstate.slots))
             # model params come back in their original (possibly half) dtypes
             new_params.append(jax.tree.map(
-                lambda x, ref: x.astype(ref.dtype), p32, group["params"]))
+                lambda x, ref: _cast_fresh(x, ref.dtype), p32, group["params"]))
         out = new_params[0] if single else new_params
         return out, OptimizerState(groups=tuple(new_groups))
 

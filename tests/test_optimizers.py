@@ -251,3 +251,37 @@ def test_lamb_hlo_has_no_flat_sized_constant():
         state, params, grads).as_text()
     # an embedded 2M-element dense constant would be tens of MB of text
     assert len(text) < 2_000_000, len(text)
+
+
+def test_master_weights_never_alias_params():
+    """Master weights and model params must be DISTINCT buffers at every
+    boundary: a same-dtype astype in eager JAX returns the identical
+    Array object, so with fp32 params the master would alias the params
+    and a donating train step then donates the same buffer twice (the
+    imagenet-example crash). Pinned by object-identity checks, which
+    fail on the aliasing astype regardless of backend."""
+    import functools
+    params = {"w": jnp.ones((8,), jnp.float32)}
+    opt = FusedAdam(params, lr=1e-3, master_weights=True)
+    state = opt.init()
+    assert state.groups[0].master["w"] is not params["w"]
+
+    g = {"w": jnp.full((8,), 0.1, jnp.float32)}
+    p2, s2 = opt.apply(state, params, g)         # eager apply
+    assert p2["w"] is not s2.groups[0].master["w"]
+
+    ckpt = {"w": jnp.full((8,), 2.0, jnp.float32)}
+    p3, s3 = opt.restore_master(s2, ckpt)
+    assert p3["w"] is not s3.groups[0].master["w"]
+    assert s3.groups[0].master["w"] is not ckpt["w"]
+    m = opt.master_params(s3)
+    assert m["w"] is not s3.groups[0].master["w"]
+
+    # and the donating-step shape that originally crashed
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, state, g):
+        return opt.apply(state, params, g)
+
+    p4, s4 = step(p3, s3, g)
+    p5, s5 = step(p4, s4, g)
+    assert np.isfinite(np.asarray(p5["w"])).all()
