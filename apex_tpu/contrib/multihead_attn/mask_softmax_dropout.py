@@ -6,8 +6,11 @@ softmax stage of attention as its own fused op, with pad mask and
 probability dropout, keeping the dropout mask for exact backward.
 
 TPU: one jit region; dropout uses an explicit key; backward follows from
-the ops' custom VJPs (dropout mask reconstructed from the same key —
-no mask storage, same math).
+the ops' custom VJPs. The dropout keep mask is saved by autodiff as a
+residual (like the reference, which stores the mask); mask-free
+regeneration-in-backward exists only in the Pallas flash-attention
+kernel (``ops/flash_attention.py``), where the counter-based RNG runs
+in-kernel.
 """
 
 from __future__ import annotations

@@ -300,9 +300,16 @@ class GPT(nn.Module):
             logits, mut = self.apply(variables, ids,
                                      mutable=["intermediates"])
             ce = jnp.mean(vocab_parallel_cross_entropy(logits, labels))
-            auxes = jax.tree.leaves(mut["intermediates"])
-            return ce + self.cfg.moe_aux_coeff * (
-                sum(auxes) / max(len(auxes), 1))
+            # summed over MoE layers (Switch/GShard sum per-layer aux so
+            # load-balancing pressure is depth-independent per layer);
+            # select only the moe_aux sows — other intermediates (e.g.
+            # future diagnostics) must not leak into the objective
+            auxes = [leaf
+                     for path, leaf in jax.tree_util.tree_flatten_with_path(
+                         mut["intermediates"])[0]
+                     if any(getattr(k, "key", None) == "moe_aux"
+                            for k in path)]
+            return ce + self.cfg.moe_aux_coeff * sum(auxes)
         logits = self.apply(variables, ids)
         losses = vocab_parallel_cross_entropy(logits, labels)
         return jnp.mean(losses)

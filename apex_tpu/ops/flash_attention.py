@@ -617,6 +617,15 @@ def _flash_bwd_impl(res, do, *, scale, causal, dropout_rate, block_q,
     # [sk, d] dk/dv accumulators fit the scoped-VMEM budget (fp32 scratch
     # pair + the dk/dv output blocks in their own dtype)
     kv_bytes = sk_p * d * (8 + k.dtype.itemsize + v.dtype.itemsize)
+    # bias rides as an extra [block_q, block_k] fp32 operand block and
+    # dropout regenerates a same-shape keep mask in VMEM; the 2 MB cap
+    # was measured without either, so count them against the same gate
+    # (at the default 1024 blocks this routes bias/dropout shapes to the
+    # two-kernel path, which keeps O(block) VMEM)
+    if use_bias:
+        kv_bytes += 4 * block_q * block_k
+    if dropout_rate > 0.0:
+        kv_bytes += 4 * block_q * block_k
     if n_kb >= 2 and kv_bytes <= _FUSED_BWD_MAX_KV_BYTES:
         especs, eops = extra(qdim=2, kdim=3)
         kvspec = pl.BlockSpec((1, 1, sk_p, d), lambda *g: (g[0], g[1], 0, 0))

@@ -10,6 +10,9 @@ from apex_tpu.transformer import parallel_state  # noqa: F401
 from apex_tpu.transformer import tensor_parallel  # noqa: F401
 from apex_tpu.transformer import pipeline_parallel  # noqa: F401
 from apex_tpu.transformer import functional  # noqa: F401
+from apex_tpu.transformer.microbatches import (  # noqa: F401
+    ConstantNumMicroBatches, NumMicroBatchesCalculator,
+    RampupBatchsizeNumMicroBatches, build_num_microbatches_calculator)
 from apex_tpu.transformer.moe import (  # noqa: F401
     ExpertParallelMLP, expert_parallel_mlp, top1_routing)
 from apex_tpu.transformer.ring_attention import (  # noqa: F401

@@ -1,0 +1,178 @@
+"""Microbatch calculators: constant + batch-size rampup.
+
+Capability parity with
+``apex/transformer/tensor_parallel/microbatches.py:20-160``
+(``build_num_microbatches_calculator`` → ``ConstantNumMicroBatches`` /
+``RampupBatchsizeNumMicroBatches``): given a global batch size, a
+microbatch size, and the data-parallel width, decide how many
+microbatches each rank's pipeline / grad-accumulation loop runs, with
+optional linear global-batch-size rampup over the first N consumed
+samples (the Megatron ``--rampup-batch-size`` recipe).
+
+TPU note: these are HOST-side schedule objects, deliberately plain
+Python, and ``n_microbatches`` is resolved to an int AT TRACE TIME. A
+jitted step that closed over a calculator bakes in the count it had when
+first traced — later ``update()`` calls cannot reach inside the cached
+executable. The supported rampup pattern is Megatron's: the host loop
+calls ``update(consumed_samples)`` after each step and passes the
+current ``get()`` value (or the calculator, re-traced) into the step
+builder, so each distinct microbatch count compiles once (a handful over
+a whole run; XLA caches each). See :func:`resolve_num_microbatches`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def resolve_num_microbatches(n) -> int:
+    """Accept a raw int or a calculator wherever schedules take
+    ``n_microbatches``.
+
+    Resolution happens at trace time: inside ``jit`` the value is frozen
+    into the compiled step. To act on a rampup, re-invoke the (jitted)
+    step builder after ``calculator.update(...)`` changes ``get()`` —
+    each new count is a new trace (see the module docstring).
+    """
+    if isinstance(n, NumMicroBatchesCalculator):
+        return n.get()
+    return int(n)
+
+
+class NumMicroBatchesCalculator:
+    """Base contract (reference ``microbatches.py:62-76``)."""
+
+    def __init__(self):
+        self.num_micro_batches: Optional[int] = None
+        self.current_global_batch_size: Optional[int] = None
+
+    def get(self) -> int:
+        return self.num_micro_batches
+
+    def get_current_global_batch_size(self) -> int:
+        return self.current_global_batch_size
+
+    def update(self, consumed_samples: int,
+               consistency_check: bool = False) -> None:
+        raise NotImplementedError
+
+
+class ConstantNumMicroBatches(NumMicroBatchesCalculator):
+    """Fixed global batch size (reference ``microbatches.py:79-91``)."""
+
+    def __init__(self, global_batch_size: int, micro_batch_size: int,
+                 data_parallel_size: int):
+        super().__init__()
+        per_step = micro_batch_size * data_parallel_size
+        if global_batch_size % per_step:
+            raise ValueError(
+                f"global batch size ({global_batch_size}) is not divisible "
+                f"by micro batch size ({micro_batch_size}) times data "
+                f"parallel size ({data_parallel_size})")
+        self.num_micro_batches = global_batch_size // per_step
+        if self.num_micro_batches < 1:
+            raise ValueError("need at least one microbatch")
+        self.current_global_batch_size = global_batch_size
+
+    def update(self, consumed_samples: int,
+               consistency_check: bool = False) -> None:
+        return None
+
+
+class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
+    """Linear global-batch-size rampup (reference ``microbatches.py:94-160``).
+
+    The global batch size steps from ``start_batch_size`` to
+    ``global_batch_size`` in ``batch_size_increment`` steps, spending
+    ``rampup_samples / num_increments`` consumed samples at each size.
+    Call :meth:`update` with the running consumed-sample count after each
+    step (as Megatron's training loop does); :meth:`get` then reflects
+    the current microbatch count.
+    """
+
+    def __init__(self, start_batch_size: int, batch_size_increment: int,
+                 rampup_samples: int, global_batch_size: int,
+                 micro_batch_size: int, data_parallel_size: int):
+        super().__init__()
+        if start_batch_size <= 0 or global_batch_size <= 0:
+            raise ValueError("batch sizes must be positive")
+        if batch_size_increment <= 0:
+            raise ValueError("batch_size_increment must be positive")
+        if rampup_samples < 0:
+            raise ValueError("rampup_samples must be >= 0")
+        diff = global_batch_size - start_batch_size
+        if diff < 0:
+            raise ValueError(
+                f"start_batch_size ({start_batch_size}) exceeds "
+                f"global_batch_size ({global_batch_size})")
+        if diff % batch_size_increment:
+            raise ValueError(
+                f"global batch size interval ({diff}) must be divisible by "
+                f"batch size increment ({batch_size_increment})")
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_size = data_parallel_size
+        self.micro_batch_times_data_parallel_size = (
+            micro_batch_size * data_parallel_size)
+        if self.micro_batch_times_data_parallel_size <= 0:
+            raise ValueError("micro_batch_size * data_parallel_size must be "
+                             "positive")
+        if start_batch_size < self.micro_batch_times_data_parallel_size:
+            raise ValueError(
+                f"start_batch_size ({start_batch_size}) yields zero "
+                f"microbatches at micro_batch_size ({micro_batch_size}) x "
+                f"data parallel size ({data_parallel_size})")
+        self.start_batch_size = start_batch_size
+        self.batch_size_increment = batch_size_increment
+        self.global_batch_size = global_batch_size
+        self.rampup_samples = rampup_samples
+        num_increments = max(diff // batch_size_increment, 1)
+        # rampup_samples == 0 means "no rampup": jump straight to the full
+        # global batch size (guards the steps division in update)
+        self.rampup_samples_per_increment = (
+            rampup_samples / num_increments if rampup_samples > 0 else 0.0)
+        self.update(0, False)
+
+    def update(self, consumed_samples: int,
+               consistency_check: bool = False) -> None:
+        if consumed_samples >= self.rampup_samples:
+            self.current_global_batch_size = self.global_batch_size
+        else:
+            steps = int(consumed_samples / self.rampup_samples_per_increment)
+            self.current_global_batch_size = min(
+                self.start_batch_size + steps * self.batch_size_increment,
+                self.global_batch_size)
+        if consistency_check and (
+                self.current_global_batch_size
+                % self.micro_batch_times_data_parallel_size):
+            raise ValueError(
+                f"current global batch size "
+                f"({self.current_global_batch_size}) is not divisible by "
+                f"micro-batch-size ({self.micro_batch_size}) times data "
+                f"parallel size ({self.data_parallel_size})")
+        self.num_micro_batches = (self.current_global_batch_size
+                                  // self.micro_batch_times_data_parallel_size)
+
+
+def build_num_microbatches_calculator(
+        global_batch_size: int, micro_batch_size: int,
+        data_parallel_size: int,
+        rampup_batch_size: Optional[Sequence[int]] = None,
+) -> NumMicroBatchesCalculator:
+    """Factory mirroring reference ``microbatches.py:20-59`` with explicit
+    arguments instead of the Megatron args namespace.
+
+    ``rampup_batch_size``: None for constant, else the 3-tuple
+    ``(start_batch_size, batch_size_increment, rampup_samples)``.
+    """
+    if rampup_batch_size is None:
+        return ConstantNumMicroBatches(
+            global_batch_size, micro_batch_size, data_parallel_size)
+    if len(rampup_batch_size) != 3:
+        raise ValueError(
+            "rampup_batch_size must be (start_batch_size, "
+            "batch_size_increment, rampup_samples); got "
+            f"{rampup_batch_size!r}")
+    start, incr, samples = (int(v) for v in rampup_batch_size)
+    return RampupBatchsizeNumMicroBatches(
+        start, incr, samples, global_batch_size, micro_batch_size,
+        data_parallel_size)

@@ -129,14 +129,13 @@ def expert_parallel_mlp(x, router_w, wi, wo, *,
         raise ValueError(
             f"router has {router_w.shape[-1]} experts but wi provides "
             f"{e_local} x ep={ep} = {E}")
+    if num_selected_experts not in (1, 2):
+        raise ValueError(
+            f"num_selected_experts must be 1 or 2, got {num_selected_experts}")
     # capacity scales with the assignments per token (GShard sizes top-2
     # buffers at 2*cf*t/E — without this, second choices are mostly
     # dropped at the default capacity_factor)
     capacity = max(1, int(capacity_factor * num_selected_experts * t / E))
-
-    if num_selected_experts not in (1, 2):
-        raise ValueError(
-            f"num_selected_experts must be 1 or 2, got {num_selected_experts}")
     # router in fp32 (the switch recipe); expert compute stays in x.dtype
     # so bf16 training keeps MXU rate on the FLOPs-dominant einsums
     logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.microbatches import resolve_num_microbatches
 from apex_tpu.transformer.pipeline_parallel.p2p import (
     ring_shift, send_forward_recv_forward)
 
@@ -38,7 +39,9 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
     ``stage_fn(params, h) -> h`` is one stage; output shape == input shape.
     Returns [n_microbatches, mb, ...] final-stage outputs (valid on the
     last stage; replicate/psum externally if every stage needs them).
+    ``n_microbatches`` may be an int or a ``NumMicroBatchesCalculator``.
     """
+    n_microbatches = resolve_num_microbatches(n_microbatches)
     n_stages = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     total_ticks = n_microbatches + n_stages - 1
@@ -76,6 +79,8 @@ def forward_backward_no_pipelining(loss_fn: Callable, params, batch,
 
     ``loss_fn(params, microbatch) -> scalar``. Returns (mean loss, grads).
     """
+    n_microbatches = resolve_num_microbatches(n_microbatches)
+
     def scan_body(acc, mb):
         loss, g = jax.value_and_grad(loss_fn)(params, mb)
         return jax.tree.map(lambda a, b: a + b, acc, (loss, g)), None
@@ -95,6 +100,7 @@ def forward_backward_pipelining_without_interleaving(
     outputs (masked to zero elsewhere, so a final ``psum`` of the loss and
     grads is exact). Runs inside shard_map over the pipeline axis.
     """
+    n_microbatches = resolve_num_microbatches(n_microbatches)
     n_stages = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
 
@@ -132,6 +138,7 @@ def pipeline_apply_interleaved(stage_fn: Callable, chunk_params, x,
     ``x``: [n_microbatches, mb, ...]; returns [n_microbatches, mb, ...]
     final-stage outputs (valid on the last rank).
     """
+    n_microbatches = resolve_num_microbatches(n_microbatches)
     n_stages = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     V = n_chunks
@@ -187,6 +194,7 @@ def forward_backward_pipelining_with_interleaving(
         n_microbatches: int, n_chunks: Optional[int] = None,
         axis_name: str = ps.PIPELINE_AXIS):
     """Interleaved pipeline + loss, returning (loss, chunk-param grads)."""
+    n_microbatches = resolve_num_microbatches(n_microbatches)
     n_stages = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     if n_chunks is None:

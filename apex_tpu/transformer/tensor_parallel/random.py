@@ -15,6 +15,20 @@ apparatus reduces to key folding:
   the key is an argument (no state to snapshot/restore);
 - the activation memory buffer is XLA's job (rematerialization policies).
 
+Disposition of ``apex/transformer/tensor_parallel/memory.py:34-136``
+(``MemoryBuffer``/``RingMemBuffer``): deliberately NOT ported. The
+reference pre-allocates a flat device buffer and hands checkpointed
+activations views into it to dodge the CUDA caching allocator's
+fragmentation and malloc/free latency during recompute. On TPU/XLA
+neither failure mode exists: buffer lifetimes are decided at compile
+time by XLA's static allocator (no runtime malloc in the step), and the
+*policy* the buffer expressed — "keep these activations, recompute
+those" — is exactly ``jax.checkpoint``'s ``policy`` argument (e.g.
+``dots_with_no_batch_dims_saveable``). A hand-managed ring buffer would
+fight the compiler's own placement rather than help it. The capability
+(bounded activation memory for TP checkpointing) is covered by
+:func:`checkpoint` below; the mechanism is intentionally absent.
+
 The tracker class is kept for API parity with Megatron-style code.
 """
 

@@ -1,0 +1,88 @@
+"""Microbatch calculators (reference microbatches.py:20-160 parity)."""
+
+import pytest
+
+from apex_tpu.transformer.microbatches import (
+    ConstantNumMicroBatches, RampupBatchsizeNumMicroBatches,
+    build_num_microbatches_calculator, resolve_num_microbatches)
+
+
+def test_constant_basic():
+    c = ConstantNumMicroBatches(global_batch_size=64, micro_batch_size=4,
+                                data_parallel_size=2)
+    assert c.get() == 8
+    assert c.get_current_global_batch_size() == 64
+    c.update(10_000, True)  # no-op
+    assert c.get() == 8
+
+
+def test_constant_divisibility_error():
+    with pytest.raises(ValueError, match="not divisible"):
+        ConstantNumMicroBatches(65, 4, 2)
+
+
+def test_rampup_schedule():
+    # 32 -> 96 in +16 steps over 400 samples: 4 increments, 100 samples each
+    r = RampupBatchsizeNumMicroBatches(
+        start_batch_size=32, batch_size_increment=16, rampup_samples=400,
+        global_batch_size=96, micro_batch_size=4, data_parallel_size=2)
+    assert r.get_current_global_batch_size() == 32
+    assert r.get() == 4
+    r.update(99, True)
+    assert r.get_current_global_batch_size() == 32
+    r.update(100, True)
+    assert r.get_current_global_batch_size() == 48
+    assert r.get() == 6
+    r.update(399, False)
+    assert r.get_current_global_batch_size() == 80
+    r.update(401, True)
+    assert r.get_current_global_batch_size() == 96
+    assert r.get() == 12
+    r.update(10**9, True)
+    assert r.get() == 12
+
+
+def test_rampup_consistency_check():
+    # increment lands on a size not divisible by mb*dp -> only flagged
+    # when consistency_check is requested
+    r = RampupBatchsizeNumMicroBatches(
+        start_batch_size=8, batch_size_increment=4, rampup_samples=100,
+        global_batch_size=16, micro_batch_size=8, data_parallel_size=1)
+    r.update(50, False)  # size 12, not divisible by 8: tolerated
+    assert r.get_current_global_batch_size() == 12
+    with pytest.raises(ValueError, match="not divisible"):
+        r.update(50, True)
+
+
+def test_rampup_validation():
+    with pytest.raises(ValueError, match="divisible by"):
+        RampupBatchsizeNumMicroBatches(32, 10, 100, 96, 4, 2)
+    with pytest.raises(ValueError, match="exceeds"):
+        RampupBatchsizeNumMicroBatches(128, 16, 100, 96, 4, 2)
+    # start size below one microbatch would silently yield get() == 0
+    with pytest.raises(ValueError, match="zero microbatches"):
+        RampupBatchsizeNumMicroBatches(8, 8, 100, 16, 8, 2)
+
+
+def test_rampup_zero_samples_means_no_rampup():
+    r = RampupBatchsizeNumMicroBatches(
+        start_batch_size=32, batch_size_increment=16, rampup_samples=0,
+        global_batch_size=96, micro_batch_size=4, data_parallel_size=2)
+    assert r.get_current_global_batch_size() == 96
+    assert r.get() == 12
+
+
+def test_build_factory():
+    c = build_num_microbatches_calculator(64, 4, 2)
+    assert isinstance(c, ConstantNumMicroBatches)
+    r = build_num_microbatches_calculator(96, 4, 2,
+                                          rampup_batch_size=(32, 16, 400))
+    assert isinstance(r, RampupBatchsizeNumMicroBatches)
+    with pytest.raises(ValueError, match="rampup_batch_size"):
+        build_num_microbatches_calculator(96, 4, 2, rampup_batch_size=(32,))
+
+
+def test_resolve_accepts_int_and_calculator():
+    assert resolve_num_microbatches(7) == 7
+    c = ConstantNumMicroBatches(64, 4, 2)
+    assert resolve_num_microbatches(c) == 8
