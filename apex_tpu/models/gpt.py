@@ -4,7 +4,15 @@ Role: the ``apex.transformer`` GPT test model (BASELINE config 5;
 reference builds it from Column/RowParallelLinear + fused softmax in its
 mpu tests, ``apex/transformer/tensor_parallel/tests/``). Built from
 apex_tpu TP layers so the same module runs at tp=1 (plain dense) and
-tp=k inside ``shard_map`` — and under GSPMD with sharding constraints.
+tp=k inside ``shard_map``. The tp=1 form also runs under pure GSPMD:
+jit it with Megatron-style ``NamedSharding``s on the params (qkv/fc1
+column, proj/fc2 row, wte vocab) and XLA inserts the f/g collectives
+implicitly — proven by ``tests/test_transformer.py::
+test_gpt_runs_under_gspmd_sharding_constraints``. The
+explicit-collective pieces (``tensor_parallel.mappings``,
+``sequence_parallel=True``, vocab-parallel cross entropy, MoE/ring
+``all_to_all``/``ppermute``) require bound axis names and are
+shard_map-only.
 
 TPU notes: attention runs through the Pallas flash-attention kernel
 (``attention_impl="flash"``, the default; ``"fused_softmax"`` keeps the
@@ -71,16 +79,6 @@ class GPTConfig:
     def __post_init__(self):
         if self.moe_num_experts and self.moe_every < 1:
             raise ValueError(f"moe_every must be >= 1, got {self.moe_every}")
-        if self.moe_num_experts and self.sequence_parallel:
-            # under SP each tp rank holds different tokens; the MoE
-            # params are replicated over tp, so their grads would need
-            # the SP partial-grad allreduce that the grad filter only
-            # applies to LN/bias leaves — composition deliberately
-            # rejected rather than silently wrong
-            raise ValueError(
-                "moe_num_experts > 0 does not compose with "
-                "sequence_parallel=True (replicated expert params would "
-                "see per-tp-rank token shards)")
 
     @property
     def ffn(self):
@@ -204,6 +202,21 @@ class MoEMLP(nn.Module):
             2.0, "fan_in", "normal"), (e_local, h, cfg.ffn), jnp.float32)
         wo = self.param("wo", nn.initializers.variance_scaling(
             2.0, "fan_in", "normal"), (e_local, cfg.ffn, h), jnp.float32)
+        sp = ps.sequence_parallel_active(cfg.sequence_parallel)
+        if sp:
+            # MoE params are not TP-sharded, so under Megatron-SP the MoE
+            # runs on the FULL sequence: all-gather the seq-sharded tokens
+            # (routing/capacity then see every token, matching non-SP
+            # exactly), compute redundantly on each tp rank — the same
+            # compute as plain TP, where activations are replicated —
+            # and slice the local shard back out. Backward of the gather
+            # is a local SPLIT (not reduce-scatter): downstream dy comes
+            # through the output-scatter's all-gather, so each rank's
+            # d(tokens) is already the replicated-full gradient, and the
+            # expert-param grads are replicated-correct (NOT partials —
+            # they stay out of sequence_parallel_grad_filter).
+            x = tp_mappings.gather_from_tensor_model_parallel_region(
+                x, ps.TENSOR_AXIS, 1)
         b, s, _ = x.shape
         y, aux = expert_parallel_mlp(
             x.reshape(b * s, h), router, wi.astype(cfg.dtype),
@@ -211,7 +224,11 @@ class MoEMLP(nn.Module):
             capacity_factor=cfg.moe_capacity_factor,
             num_selected_experts=cfg.moe_top_k)
         self.sow("intermediates", "moe_aux", aux)
-        return y.reshape(b, s, h)
+        y = y.reshape(b, s, h)
+        if sp:
+            y = tp_mappings.scatter_to_tensor_model_parallel_region(
+                y, ps.TENSOR_AXIS, 1)
+        return y
 
 
 class GPTBlock(nn.Module):

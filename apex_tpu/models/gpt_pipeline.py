@@ -1,0 +1,203 @@
+"""Pipelined GPT: GPT blocks through the interleaved pipeline schedule.
+
+The flagship composition: pp (interleaved vpp) x tp (x dp) with remat,
+amp loss scaling, and a ZeRO-sharded optimizer — the end-to-end proof
+that the schedule engine (``pipeline_parallel/schedules.py``), the
+Megatron TP layers, and the amp/ZeRO machinery compose on a real
+transformer, not just the toy stage functions of the unit tests.
+
+The reference has no schedule engine (SURVEY §2.3: groups only); the
+Megatron intent this follows is the interleaved rank state the reference
+DOES track (``apex/transformer/parallel_state.py:252-322``): chunk ``c``
+of pipeline rank ``r`` is global stage ``c*P + r``, each stage holding
+``num_layers / (P*V)`` consecutive GPT blocks.
+
+Structure (per pipeline rank, SPMD under ``shard_map``):
+
+- ``embed`` params (VocabParallelEmbedding + wpe): replicated over the
+  pipeline axis; every rank embeds the microbatches but only rank 0's
+  result enters the pipe, so embed grads live on rank 0 —
+  ``loss_and_grads`` psums them across the pipeline axis (the Megatron
+  embedding-group allreduce generalized to full replication).
+- ``chunks`` params: every leaf stacked ``[V, L, ...]`` — V chunks of L
+  blocks; the ``chunk_params`` contract of
+  ``pipeline_apply_interleaved``. The stage function ``lax.scan``s the L
+  blocks (remat applied by the schedule).
+- ``head`` params (final LayerNorm + untied vocab-sharded LM head):
+  replicated over pp, consumed on the last rank only, grads psummed
+  like ``embed``. (Megatron's *tied* embedding needs the first+last
+  embedding group, ``parallel_state.get_embedding_axis_index_groups``;
+  the pipelined flagship uses an untied head, which is how most modern
+  deployments run.)
+
+Not composed here (explicitly rejected): ``sequence_parallel`` (the
+per-block SP gather/scatter assumes seq-sharded activations between
+blocks, but pipeline transport carries the full sequence) and MoE
+(expert-axis all_to_all inside a scanned pipeline tick is untested);
+both raise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.gpt import GPTBlock, GPTConfig
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    pipeline_apply_interleaved)
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear, VocabParallelEmbedding, vocab_parallel_cross_entropy)
+
+
+class _Embed(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, ids):
+        cfg = self.cfg
+        x = VocabParallelEmbedding(
+            num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
+            name="wte")(ids).astype(cfg.dtype)
+        pos = self.param("wpe", nn.initializers.normal(0.02),
+                         (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
+        return x + pos[None, :ids.shape[-1]].astype(cfg.dtype)
+
+
+class _Head(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        x = FusedLayerNorm(normalized_shape=cfg.hidden_size, dtype=cfg.dtype,
+                           name="ln_f")(x)
+        # untied vocab-sharded LM head; logits [..., V/tp] pair with
+        # vocab_parallel_cross_entropy exactly like GPT.wte.attend
+        return ColumnParallelLinear(
+            input_size=cfg.hidden_size, output_size=cfg.vocab_size,
+            gather_output=False, use_bias=False, name="lm_head")(x)
+
+
+class PipelinedGPT:
+    """GPT split into ``pp * n_chunks`` stages for the interleaved schedule.
+
+    Usage (inside ``shard_map`` over a mesh with the ``pipeline`` axis,
+    plus ``tensor``/``data`` as desired)::
+
+        pgpt = PipelinedGPT(cfg, n_chunks=2)
+        params = pgpt.init(jax.random.PRNGKey(0), ids_mb)  # rank-aware
+        loss, grads = pgpt.loss_and_grads(params, ids_mb, labels_mb)
+
+    ``ids_mb``/``labels_mb``: [n_microbatches, mb, s] int32 with
+    ``n_microbatches %% pp == 0`` (Megatron constraint).
+    """
+
+    def __init__(self, cfg: GPTConfig, n_chunks: int,
+                 axis_name: str = ps.PIPELINE_AXIS):
+        if cfg.sequence_parallel:
+            raise ValueError(
+                "PipelinedGPT does not compose with sequence_parallel "
+                "(pipeline transport carries the full sequence between "
+                "stages; per-block SP expects seq-sharded activations)")
+        if cfg.moe_num_experts:
+            raise ValueError("PipelinedGPT does not support MoE blocks yet")
+        pp = ps.get_pipeline_model_parallel_world_size()
+        n_stages = pp * n_chunks
+        if cfg.num_layers % n_stages:
+            raise ValueError(
+                f"num_layers ({cfg.num_layers}) must divide into pp ({pp}) "
+                f"x n_chunks ({n_chunks}) = {n_stages} stages")
+        self.cfg = cfg
+        self.pp = pp
+        self.n_chunks = n_chunks
+        self.layers_per_stage = cfg.num_layers // n_stages
+        self.axis_name = axis_name
+        self.block = GPTBlock(cfg, use_moe=False)
+        self.embed = _Embed(cfg)
+        self.head = _Head(cfg)
+
+    # -- parameters --------------------------------------------------------
+
+    def _block_key(self, key, global_layer):
+        return jax.random.fold_in(key, global_layer)
+
+    def init(self, key, ids_mb):
+        """Rank-aware init (call INSIDE shard_map): every rank gets the
+        replicated embed/head params plus ITS chunks' block params,
+        stacked [V, L, ...]. Block params for global stage ``c*P + r``
+        derive from ``fold_in(key, global_layer)`` so any (pp, V)
+        factorization — including pp=1 (sequential reference) — yields
+        the same logical weights."""
+        mb_ids = ids_mb[0]
+        k_embed, k_head, k_blocks = jax.random.split(key, 3)
+        embed_p = self.embed.init(k_embed, mb_ids)["params"]
+        h0 = jnp.zeros(mb_ids.shape + (self.cfg.hidden_size,), self.cfg.dtype)
+        head_p = self.head.init(k_head, h0)["params"]
+        rank = ps.get_pipeline_model_parallel_rank()
+        L = self.layers_per_stage
+        chunks = []
+        for c in range(self.n_chunks):
+            stage = c * self.pp + rank  # traced under shard_map: fold_in
+            layer_ps = [
+                self.block.init(
+                    self._block_key(k_blocks, stage * L + l), h0)["params"]
+                for l in range(L)]
+            chunks.append(jax.tree.map(lambda *xs: jnp.stack(xs), *layer_ps))
+        chunk_p = jax.tree.map(lambda *xs: jnp.stack(xs), *chunks)
+        return {"embed": embed_p, "chunks": chunk_p, "head": head_p}
+
+    # -- forward/backward --------------------------------------------------
+
+    def stage_fn(self, chunk_params, h):
+        """One stage = L scanned GPT blocks (the schedule wraps this in
+        ``jax.checkpoint`` when remat is on)."""
+        def body(h, p):
+            return self.block.apply({"params": p}, h, True), None
+        h, _ = jax.lax.scan(body, h, chunk_params)
+        return h
+
+    def _loss_of(self, params, ids_mb, labels_mb):
+        nmb, mb, s = ids_mb.shape
+        x = self.embed.apply({"params": params["embed"]},
+                             ids_mb.reshape(nmb * mb, s))
+        x = x.reshape(nmb, mb, s, self.cfg.hidden_size)
+        outs = pipeline_apply_interleaved(
+            self.stage_fn, params["chunks"], x, nmb, self.n_chunks,
+            self.axis_name)
+        logits = self.head.apply(
+            {"params": params["head"]},
+            outs.reshape(nmb * mb, s, self.cfg.hidden_size))
+        losses = vocab_parallel_cross_entropy(
+            logits, labels_mb.reshape(nmb * mb, s))
+        loss = jnp.mean(losses)
+        rank = jax.lax.axis_index(self.axis_name)
+        n_stages = jax.lax.axis_size(self.axis_name)
+        return jnp.where(rank == n_stages - 1, loss, 0.0)
+
+    def loss_and_grads(self, params, ids_mb, labels_mb,
+                       loss_scale: Optional[jax.Array] = None):
+        """Interleaved-pipeline forward+backward.
+
+        Returns ``(loss, grads)`` where ``loss`` is the (unscaled) scalar
+        replicated across the pipeline axis, and grads carry the contract:
+        ``embed``/``head`` grads already psummed over the pipeline axis
+        (replicated params), ``chunks`` grads per-rank (each rank owns its
+        stages). When ``loss_scale`` is given the backward runs on the
+        scaled loss and the returned grads are SCALED (unscale via the amp
+        scaler, which also does the found-inf skip logic).
+        """
+        def full(p):
+            loss = self._loss_of(p, ids_mb, labels_mb)
+            scaled = loss * loss_scale if loss_scale is not None else loss
+            return scaled, loss
+
+        grads, loss = jax.grad(full, has_aux=True)(params)
+        grads["embed"] = jax.lax.psum(grads["embed"], self.axis_name)
+        grads["head"] = jax.lax.psum(grads["head"], self.axis_name)
+        loss = jax.lax.psum(loss, self.axis_name)
+        return loss, grads
