@@ -66,7 +66,10 @@ class BertSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, pad_mask):
-        """``pad_mask``: [b, s] bool, True = real token."""
+        """``pad_mask``: [b, s] bool, True = real token; None = no
+        padding (skips the segment-id masking entirely — the flash
+        kernel's segment path costs real VPU work per block, ~6% of a
+        BERT-base step when fed an all-ones mask)."""
         cfg = self.cfg
         h = cfg.hidden_size
         tp = ps.get_tensor_model_parallel_world_size()
@@ -84,8 +87,10 @@ class BertSelfAttention(nn.Module):
 
         if cfg.use_flash:
             # padding → segment ids: real tokens segment 1, pads -1 (the
-            # kernel zeroes their rows and excludes them as keys).
-            sids = jnp.where(pad_mask, 1, -1).astype(jnp.int32)
+            # kernel zeroes their rows and excludes them as keys); no
+            # pad_mask → plain unsegmented kernel (cheaper)
+            sids = (None if pad_mask is None
+                    else jnp.where(pad_mask, 1, -1).astype(jnp.int32))
             ctx = flash_attention(
                 q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
                 v.transpose(0, 2, 1, 3),
@@ -99,7 +104,8 @@ class BertSelfAttention(nn.Module):
                 input_in_bf16=cfg.dtype == jnp.bfloat16,
                 attn_mask_type=AttnMaskType.padding,
                 scale=head_dim ** -0.5)
-            mask = ~pad_mask[:, None, None, :]        # True = masked out
+            mask = (None if pad_mask is None
+                    else ~pad_mask[:, None, None, :])  # True = masked out
             probs = softmax(scores.astype(cfg.dtype), mask)
             ctx = jnp.einsum("bhst,bthd->bshd", probs.astype(cfg.dtype), v,
                              preferred_element_type=jnp.float32
@@ -142,9 +148,7 @@ class Bert(nn.Module):
     @nn.compact
     def __call__(self, ids, pad_mask=None, type_ids=None):
         """Returns [b, s, V/tp] MLM logits (tied to the embedding shard)."""
-        cfg = self.cfg
-        if pad_mask is None:
-            pad_mask = jnp.ones(ids.shape, bool)
+        cfg = self.cfg  # pad_mask=None means "no padding" end-to-end
         wte = VocabParallelEmbedding(
             num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
             name="wte")

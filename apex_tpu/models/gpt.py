@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.ops.flash_attention import flash_attention
 from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.ring_attention import zigzag_ring_self_attention
 from apex_tpu.transformer.enums import AttnMaskType
 from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
 from apex_tpu.transformer.tensor_parallel import (
@@ -109,6 +110,11 @@ class ParallelSelfAttention(nn.Module):
             raise ValueError(
                 f"attention_impl must be 'flash' or 'fused_softmax', got "
                 f"{cfg.attention_impl!r}")
+        cp = ps.axis_size_if_bound(ps.CONTEXT_AXIS)
+        if cp > 1 and cfg.attention_impl != "flash":
+            raise ValueError(
+                "context parallelism requires attention_impl='flash' "
+                "(the ring paths are kernel-backed)")
         drop = (cfg.attention_dropout
                 if (cfg.attention_dropout > 0 and not deterministic) else 0.0)
         if cfg.attention_impl == "flash":
@@ -120,13 +126,22 @@ class ParallelSelfAttention(nn.Module):
                 # fold the tp rank into the seed: the kernel hashes the
                 # LOCAL head index, so replicated rngs would repeat masks
                 # across head shards (Megatron's per-rank RNG offsets,
-                # apex/transformer/tensor_parallel/random.py:131-206)
+                # apex/transformer/tensor_parallel/random.py:131-206);
+                # the cp rank is folded per ring step inside the ring
                 seed = (jax.random.randint(self.make_rng("dropout"), (), 0,
                                            2 ** 30 - 1, jnp.int32)
                         + ps.get_tensor_model_parallel_rank())
-            ctx = flash_attention(qh, kh, vh, causal=True,
-                                  scale=head_dim ** -0.5,
-                                  dropout_rate=drop, dropout_seed=seed)
+            if cp > 1:
+                # context parallel: zigzag ring attention over the local
+                # sequence shard (inputs/labels in zigzag layout, see
+                # GPT.__call__ position handling); causal by construction
+                ctx = zigzag_ring_self_attention(
+                    qh, kh, vh, scale=head_dim ** -0.5,
+                    dropout_rate=drop, dropout_seed=seed)
+            else:
+                ctx = flash_attention(qh, kh, vh, causal=True,
+                                      scale=head_dim ** -0.5,
+                                      dropout_rate=drop, dropout_seed=seed)
             ctx = ctx.transpose(0, 2, 1, 3)       # [b, s, hp, d]
         else:  # "fused_softmax": the unfused numerics-debug path
             scores = jnp.einsum("bshd,bthd->bhst", q, k,
@@ -248,6 +263,10 @@ class GPTBlock(nn.Module):
                     # activations are replicated and must drop identically)
                     key = jax.random.fold_in(
                         key, ps.get_tensor_model_parallel_rank())
+                if ps.axis_size_if_bound(ps.CONTEXT_AXIS) > 1:
+                    # context shards hold different tokens too
+                    key = jax.random.fold_in(
+                        key, ps.get_context_parallel_rank())
                 return nn.Dropout(cfg.hidden_dropout, deterministic=False)(
                     y, rng=key)
             return y
@@ -277,7 +296,28 @@ class GPT(nn.Module):
         x = wte(ids).astype(cfg.dtype)
         pos = self.param("wpe", nn.initializers.normal(0.02),
                          (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
-        x = x + pos[None, :ids.shape[1]].astype(cfg.dtype)
+        cp = ps.axis_size_if_bound(ps.CONTEXT_AXIS)
+        if cp > 1:
+            # context parallel: ids are the local ZIGZAG shard — global
+            # chunks (r, 2cp-1-r) of the full sequence — so position
+            # embeddings index the matching global rows
+            s_local = ids.shape[1]
+            if s_local % 2:
+                raise ValueError(
+                    f"context parallelism needs an even local seq len, "
+                    f"got {s_local}")
+            if cp * s_local > cfg.max_seq_len:
+                raise ValueError(
+                    f"global seq ({cp}x{s_local}) exceeds max_seq_len "
+                    f"({cfg.max_seq_len})")
+            half = s_local // 2
+            r = jax.lax.axis_index(ps.CONTEXT_AXIS)
+            pos_idx = jnp.concatenate([
+                r * half + jnp.arange(half),
+                (2 * cp - 1 - r) * half + jnp.arange(half)])
+            x = x + jnp.take(pos, pos_idx, axis=0)[None].astype(cfg.dtype)
+        else:
+            x = x + pos[None, :ids.shape[1]].astype(cfg.dtype)
         sp = ps.sequence_parallel_active(cfg.sequence_parallel)
         if sp:
             tp = ps.get_tensor_model_parallel_world_size()

@@ -97,7 +97,14 @@ def mha_reference(q, k, v, *, causal=False, segment_ids_q=None,
 
 def _block_mask(qi, kb, block_q, block_k, causal, causal_offset,
                 sq_ref, skv_ref):
-    """[block_q, block_k] validity mask for block (qi, kb)."""
+    """[block_q, block_k] validity mask for block (qi, kb), or None when
+    nothing masks (not causal, no segments) — skipping the two where()
+    passes and the iota/compare construction saves real VPU time in the
+    exp-bound d=64 regime (~6% of a BERT-base step). The unmasked case
+    is only reachable with unpadded operands: ``_pad_operands`` installs
+    segment ids whenever it pads."""
+    if not causal and sq_ref is None:
+        return None
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -209,20 +216,24 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, use_segments,
         q = q_ref[0, 0]                                  # [block_q, d]
         k = k_ref[0, 0]                                  # [block_k, d]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
+                                preferred_element_type=jnp.float32)
+        if scale != 1.0:
+            s = s * scale
         if use_bias:
             s = s + bias_ref[0, 0].astype(jnp.float32)
 
         mask = _block_mask(qi, kb, block_q, block_k, causal, causal_offset,
                            sq_ref, skv_ref)
-        s = jnp.where(mask, s, _NEG_INF)
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_scr[:]                                 # [block_q, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        # guard fully-masked rows (padding): keep exp at 0
         p = jnp.exp(s - m_new)
-        p = jnp.where(mask, p, 0.0)
+        if mask is not None:
+            # guard fully-masked rows (padding): keep exp at 0
+            p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
         if dropout_rate > 0.0:
@@ -305,6 +316,13 @@ def _bias_spec(bias, block_q, block_k, qdim, kdim):
     return pl.BlockSpec((1, 1, block_q, block_k), bmap)
 
 
+# Negative result (measured, v5e): folding the softmax scale into q
+# before the kernel (to skip the per-block s*scale VPU pass) changed
+# NOTHING — 8.09 vs 7.95 ms/call on the BERT-shape fwd+bwd microbench.
+# Mosaic already handles the scalar epilogue efficiently; the kernels
+# keep the straightforward `s * scale` (guarded for callers passing 1.0).
+
+
 def _flash_fwd_impl(q, k, v, segment_ids_q, segment_ids_kv, bias, seed,
                     scale, causal, dropout_rate, block_q, block_k, interpret):
     b, h, sq, d = q.shape
@@ -373,15 +391,22 @@ def _flash_fwd_impl(q, k, v, segment_ids_q, segment_ids_kv, bias, seed,
 # ---------------------------------------------------------------------------
 
 def _recompute_p(q_ref, k_ref, lse_ref, bias_ref, mask, scale):
-    """p = exp(s - lse), zeroed where masked. [block_q, block_k]."""
+    """p = exp(s - lse), zeroed where masked. [block_q, block_k].
+    ``mask=None`` = fully live (no padding can reach here, see
+    ``_block_mask``), so the where() guards — which also protect
+    padding rows whose lse is -1e30 — are safely skipped."""
     q = q_ref[0, 0]                # native dtype: bf16 MXU path (see fwd)
     k = k_ref[0, 0]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+                            preferred_element_type=jnp.float32)
+    if scale != 1.0:
+        s = s * scale
     if bias_ref is not None:
         s = s + bias_ref[0, 0].astype(jnp.float32)
-    s = jnp.where(mask, s, _NEG_INF)
     lse_col = lse_ref[0, 0, 0][:, None]          # [block_q, 1] (relayout)
+    if mask is None:
+        return jnp.exp(s - lse_col)
+    s = jnp.where(mask, s, _NEG_INF)
     return jnp.where(mask, jnp.exp(s - lse_col), 0.0)
 
 
@@ -407,7 +432,9 @@ def _p_dp_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
         dp = jnp.where(keep, dp, 0.0) * inv
     else:
         p_drop = p
-    ds = p * (dp - delta_ref[0, 0, 0][:, None]) * scale
+    ds = p * (dp - delta_ref[0, 0, 0][:, None])
+    if scale != 1.0:
+        ds = ds * scale
     return p_drop, do, ds
 
 

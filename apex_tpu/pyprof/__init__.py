@@ -14,3 +14,5 @@ them from kernel names).
 
 from apex_tpu.pyprof.nvtx import annotate, init, wrap  # noqa: F401
 from apex_tpu.pyprof.prof import cost_analysis, flop_report, trace  # noqa: F401
+from apex_tpu.pyprof import parse  # noqa: F401
+from apex_tpu.pyprof.parse import format_table, op_stats, top_ops  # noqa: F401

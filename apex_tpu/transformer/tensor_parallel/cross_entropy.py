@@ -49,18 +49,24 @@ def _vce_core(logits, target, axis_name):
     # 1) global max for stability (cross_entropy.py:28-33)
     lmax = jnp.max(logits, axis=-1).astype(jnp.float32)
     lmax = ps.pmax_if_bound(lmax, axis_name)
-    shifted = logits.astype(jnp.float32) - lmax[..., None]
 
     # 2) predicted (target) logit: local-range gather + allreduce (:35-57)
+    # — gathered from the RAW logits, not a shifted copy: with a single
+    # consumer the fp32 ``logits - lmax`` array below fuses into the
+    # exp-reduce instead of materializing [.., V/tp] fp32 (measured
+    # ~3 ms/step on BERT-base: one 1 GB write + fp32 re-reads)
     local_t = target - start
     in_range = (local_t >= 0) & (local_t < part_v)
     local_t = jnp.where(in_range, local_t, 0)
-    pred = jnp.take_along_axis(shifted, local_t[..., None], axis=-1)[..., 0]
+    pred = (jnp.take_along_axis(logits, local_t[..., None], axis=-1)[..., 0]
+            .astype(jnp.float32) - lmax)
     pred = jnp.where(in_range, pred, 0.0)
     pred = ps.psum_if_bound(pred, axis_name)
 
-    # 3) sum-exp allreduce (:59-69)
-    sum_exp = ps.psum_if_bound(jnp.sum(jnp.exp(shifted), axis=-1), axis_name)
+    # 3) sum-exp allreduce (:59-69); the subtract fuses into this reduce
+    sum_exp = ps.psum_if_bound(
+        jnp.sum(jnp.exp(logits.astype(jnp.float32) - lmax[..., None]),
+                axis=-1), axis_name)
 
     loss = jnp.log(sum_exp) - pred
     return loss, lmax, sum_exp, in_range, local_t

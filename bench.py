@@ -181,7 +181,22 @@ def _bench_fused_adam():
     return dt_eager / dt_fused, dt_fused, dt_eager
 
 
-def _time_train_step(step, args, tokens, n=10, rebind=None):
+def _trace_top_ops(run_once, name: str):
+    """One traced step → top-5 per-op rows (self-time %, bound_by) via
+    apex_tpu.pyprof.parse — the automated pipeline the docs previously
+    described as a manual recipe. Returns a JSON-compact list or None."""
+    import tempfile
+    try:
+        from apex_tpu.pyprof import parse as pparse, trace as ptrace
+        d = tempfile.mkdtemp(prefix=f"apexops_{name}_")
+        with ptrace(d):
+            run_once()
+        return pparse.top_ops(d, 5)
+    except Exception:
+        return None
+
+
+def _time_train_step(step, args, tokens, n=10, rebind=None, profile=None):
     """Time a jitted train step whose first output is the loss scalar.
 
     One warm call, then n timed calls; the final scalar host transfer is
@@ -190,7 +205,9 @@ def _time_train_step(step, args, tokens, n=10, rebind=None):
     ``rebind(args, out) -> args`` so successive calls form a true data
     dependency chain and that last transfer provably fences all n;
     without carried state the device still executes same-stream programs
-    in launch order. Returns (tokens_per_sec, mfu|None)."""
+    in launch order. ``profile``: a name to also capture one traced step
+    and return its top-5 op table. Returns (tokens_per_sec, mfu|None,
+    top_ops|None)."""
     flops = _step_flops(step, *args)
     out = step(*args)
     float(out[0])
@@ -205,7 +222,10 @@ def _time_train_step(step, args, tokens, n=10, rebind=None):
     dt = (time.perf_counter() - t0) / n
     peak = _peak_flops()
     mfu = flops / dt / peak if (flops and peak) else None
-    return tokens / dt, mfu
+    ops = None
+    if profile:
+        ops = _trace_top_ops(lambda: float(step(*args)[0]), profile)
+    return tokens / dt, mfu, ops
 
 
 def _bench_gpt():
@@ -231,7 +251,7 @@ def _bench_gpt():
     def step(v, ids, labels):
         return jax.value_and_grad(lambda v: model.loss(v, ids, labels))(v)
 
-    return _time_train_step(step, (v, ids, labels), b * s)
+    return _time_train_step(step, (v, ids, labels), b * s, profile="gpt")
 
 
 def _bench_bert():
@@ -247,7 +267,10 @@ def _bench_bert():
         vocab_parallel_cross_entropy)
 
     ps.destroy_model_parallel()
-    b, s = 16, 512
+    # b=32 measured best on v5e (b16 leaves LAMB un-overlapped with the
+    # backward tail; b64 and the s=128 phase-1 shape both measured lower
+    # MFU — see docs/perf.md BERT table)
+    b, s = 32, 512
     model = Bert(BertConfig(dtype=jnp.bfloat16))
     rng = np.random.RandomState(1)
     ids = jnp.asarray(rng.randint(0, 30000, (b, s)), jnp.int32)
@@ -267,7 +290,8 @@ def _bench_bert():
 
     return _time_train_step(
         step, (v, state, ids, labels), b * s,
-        rebind=lambda args, out: (out[1], out[2], args[2], args[3]))
+        rebind=lambda args, out: (out[1], out[2], args[2], args[3]),
+        profile="bert")
 
 
 def main():
@@ -291,17 +315,21 @@ def main():
         except Exception as e:
             extras["fused_adam_error"] = f"{type(e).__name__}: {e}"[:120]
         try:
-            gpt_tps, gpt_mfu = _bench_gpt()
+            gpt_tps, gpt_mfu, gpt_ops = _bench_gpt()
             extras["gpt_tokens_per_sec"] = round(gpt_tps, 1)
             if gpt_mfu:
                 extras["gpt_mfu"] = round(gpt_mfu, 4)
+            if gpt_ops:
+                extras["gpt_top_ops"] = gpt_ops
         except Exception as e:
             extras["gpt_error"] = f"{type(e).__name__}: {e}"[:120]
         try:
-            bert_tps, bert_mfu = _bench_bert()
+            bert_tps, bert_mfu, bert_ops = _bench_bert()
             extras["bert_tokens_per_sec"] = round(bert_tps, 1)
             if bert_mfu:
                 extras["bert_mfu"] = round(bert_mfu, 4)
+            if bert_ops:
+                extras["bert_top_ops"] = bert_ops
         except Exception as e:
             extras["bert_error"] = f"{type(e).__name__}: {e}"[:120]
         import jax

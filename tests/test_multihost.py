@@ -16,6 +16,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow
 def test_two_process_train_step():
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)

@@ -29,7 +29,7 @@ def _run_cp(mesh, fn, *args):
 
 
 def test_ring_attention_full():
-    mesh = _setup()
+    mesh = _setup(4)
     q, k, v = _qkv()
     out = _run_cp(mesh, lambda q, k, v: ring_self_attention(q, k, v), q, k, v)
     ref = mha_reference(q, k, v)
@@ -79,7 +79,7 @@ def test_ulysses_attention():
 
 def test_ring_attention_grads_noncausal():
     """Non-causal backward (second ring pass, traveling dk/dv accumulators)."""
-    mesh = _setup()
+    mesh = _setup(4)
     q, k, v = _qkv(b=1, h=2, s=32, d=4, seed=3)
 
     def loss_ring(q, k, v):
@@ -153,8 +153,8 @@ def test_zigzag_split_merge_roundtrip():
 def test_zigzag_ring_matches_reference_causal():
     from apex_tpu.transformer.ring_attention import (
         zigzag_merge, zigzag_ring_self_attention, zigzag_split)
-    mesh = _setup()
-    cp = 8
+    cp = 4
+    mesh = _setup(cp)
     q, k, v = _qkv(b=1, h=2, s=64, d=4, seed=11)
     qz, kz, vz = (zigzag_split(t, cp) for t in (q, k, v))
 
@@ -170,8 +170,8 @@ def test_zigzag_ring_matches_reference_causal():
 def test_zigzag_ring_grads():
     from apex_tpu.transformer.ring_attention import (
         zigzag_merge, zigzag_ring_self_attention, zigzag_split)
-    mesh = _setup()
-    cp = 8
+    cp = 4
+    mesh = _setup(cp)
     q, k, v = _qkv(b=1, h=2, s=64, d=4, seed=12)
 
     def loss_zz(q, k, v):
@@ -192,4 +192,329 @@ def test_zigzag_ring_grads():
     for a, r in zip(g1, g2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                    rtol=1e-3, atol=1e-4)
+    ps.destroy_model_parallel()
+
+
+def _stepseed(seed, r, src, pair=0):
+    """Host mirror of ring_attention._step_seed (int32 wraparound)."""
+    return np.int32(np.uint32(seed) + np.uint32(r) * np.uint32(1000003)
+                    + np.uint32(src) * np.uint32(7919)
+                    + np.uint32(pair) * np.uint32(104729))
+
+
+def _dropped_ref(q, k, v, keep, rate, causal_mask):
+    """Reference attention with an explicit keep mask applied to the
+    normalized probabilities (the kernel's dropout semantics)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (d ** -0.5)
+    s = jnp.where(causal_mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(causal_mask, p, 0.0)
+    p = jnp.where(keep, p / (1.0 - rate), 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def test_ring_attention_dropout_exact_parity():
+    """In-kernel dropout inside the ring: outputs and grads must match a
+    reference built from the per-step counter masks (seed folded with
+    q-owner rank and visiting chunk), proving masks are independent per
+    ring step/device and regenerate identically in backward."""
+    from apex_tpu.ops.flash_attention import dropout_keep_reference
+    from apex_tpu.transformer.ring_attention import ring_self_attention
+
+    cp, rate, seed = 4, 0.3, 1234
+    mesh = _setup(cp)
+    b, h, s, d = 1, 2, 32, 4
+    s_local = s // cp
+    q, k, v = _qkv(b=b, h=h, s=s, d=d, seed=21)
+
+    # assemble the global keep mask from the per-(rank, src) step seeds
+    keep = np.ones((b, h, s, s), bool)
+    for r in range(cp):
+        for src in range(cp):
+            if src > r:
+                continue  # skipped (future) — causal mask kills it anyway
+            blk = dropout_keep_reference(
+                int(_stepseed(seed, r, src)), b, h, s_local, s_local, rate)
+            keep[:, :, r * s_local:(r + 1) * s_local,
+                 src * s_local:(src + 1) * s_local] = np.asarray(blk)
+    keep = jnp.asarray(keep)
+    causal_mask = jnp.tril(jnp.ones((s, s), bool))[None, None]
+
+    def loss_ring(q, k, v):
+        def inner(q, k, v):
+            o = ring_self_attention(q, k, v, causal=True, dropout_rate=rate,
+                                    dropout_seed=seed)
+            return jax.lax.psum(jnp.sum(jnp.tanh(o)), "context"), o
+        return shard_map(inner, mesh=mesh,
+                         in_specs=tuple(P(None, None, "context")
+                                        for _ in range(3)),
+                         out_specs=(P(), P(None, None, "context")),
+                         check_vma=False)(q, k, v)
+
+    def loss_ref(q, k, v):
+        o = _dropped_ref(q, k, v, keep, rate, causal_mask)
+        return jnp.sum(jnp.tanh(o)), o
+
+    (l1, o1), g1 = jax.value_and_grad(loss_ring, (0, 1, 2),
+                                      has_aux=True)(q, k, v)
+    (l2, o2), g2 = jax.value_and_grad(loss_ref, (0, 1, 2),
+                                      has_aux=True)(q, k, v)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                               rtol=1e-4, atol=1e-5)
+    for a, r in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-3, atol=1e-4)
+    ps.destroy_model_parallel()
+
+
+def test_zigzag_ring_dropout_exact_parity():
+    """Zigzag ring with in-kernel dropout: parity against the per-pair
+    counter-mask reference in zigzag coordinates."""
+    from apex_tpu.ops.flash_attention import dropout_keep_reference
+    from apex_tpu.transformer.ring_attention import (
+        zigzag_ring_self_attention, zigzag_split)
+
+    cp, rate, seed = 2, 0.25, 77
+    mesh = _setup(cp)
+    b, h, s, d = 1, 2, 32, 4
+    s_local = s // cp
+    half = s_local // 2
+    q, k, v = _qkv(b=b, h=h, s=s, d=d, seed=22)
+    qz, kz, vz = (zigzag_split(t, cp) for t in (q, k, v))
+
+    # global positions of zigzag row blocks: rank r holds half-chunks
+    # (r, 2cp-1-r); build causal mask + keep mask in ZIGZAG coordinates
+    pos = np.concatenate(
+        [np.concatenate([np.arange(r * half, (r + 1) * half),
+                         np.arange((2 * cp - 1 - r) * half,
+                                   (2 * cp - r) * half)])
+         for r in range(cp)])
+    causal_mask = jnp.asarray(pos[None, :] <= pos[:, None])[None, None]
+    keep = np.ones((b, h, s, s), bool)
+    # pair blocks: (q0,k0)=0, (q1,k0)=1, (q1,k1)=2 per (rank, src)
+    for r in range(cp):
+        q0 = slice(r * s_local, r * s_local + half)
+        q1 = slice(r * s_local + half, (r + 1) * s_local)
+        for src in range(cp):
+            k0 = slice(src * s_local, src * s_local + half)
+            k1 = slice(src * s_local + half, (src + 1) * s_local)
+            if src <= r:
+                keep[:, :, q0, k0] = np.asarray(dropout_keep_reference(
+                    int(_stepseed(seed, r, src, 0)), b, h, half, half, rate))
+            keep[:, :, q1, k0] = np.asarray(dropout_keep_reference(
+                int(_stepseed(seed, r, src, 1)), b, h, half, half, rate))
+            if src >= r:
+                keep[:, :, q1, k1] = np.asarray(dropout_keep_reference(
+                    int(_stepseed(seed, r, src, 2)), b, h, half, half, rate))
+    keep = jnp.asarray(keep)
+
+    def loss_zz(qz, kz, vz):
+        def inner(q, k, v):
+            o = zigzag_ring_self_attention(q, k, v, dropout_rate=rate,
+                                           dropout_seed=seed)
+            return jax.lax.psum(jnp.sum(jnp.tanh(o)), "context"), o
+        return shard_map(inner, mesh=mesh,
+                         in_specs=tuple(P(None, None, "context")
+                                        for _ in range(3)),
+                         out_specs=(P(), P(None, None, "context")),
+                         check_vma=False)(qz, kz, vz)
+
+    def loss_ref(qz, kz, vz):
+        o = _dropped_ref(qz, kz, vz, keep, rate, causal_mask)
+        return jnp.sum(jnp.tanh(o)), o
+
+    (l1, o1), g1 = jax.value_and_grad(loss_zz, (0, 1, 2),
+                                      has_aux=True)(qz, kz, vz)
+    (l2, o2), g2 = jax.value_and_grad(loss_ref, (0, 1, 2),
+                                      has_aux=True)(qz, kz, vz)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                               rtol=1e-4, atol=1e-5)
+    for a, r in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-3, atol=1e-4)
+    ps.destroy_model_parallel()
+
+
+def test_ring_attention_segment_ids():
+    """Packed-varlen masking inside the ring: ids travel with kv chunks;
+    tokens attend only within equal non-negative segments."""
+    from apex_tpu.transformer.ring_attention import ring_self_attention
+
+    cp = 4
+    mesh = _setup(cp)
+    b, h, s, d = 1, 2, 32, 4
+    q, k, v = _qkv(b=b, h=h, s=s, d=d, seed=23)
+    rng = np.random.RandomState(24)
+    # 3 segments + trailing padding (-1)
+    sid = np.zeros((b, s), np.int32)
+    sid[:, 10:20] = 1
+    sid[:, 20:28] = 2
+    sid[:, 28:] = -1
+    sid = jnp.asarray(sid)
+
+    def run(q, k, v, sid, q_only):
+        def inner(q, k, v, sid):
+            return ring_self_attention(
+                q, k, v, causal=True, segment_ids_q=sid,
+                segment_ids_kv=None if q_only else sid)
+        return shard_map(inner, mesh=mesh,
+                         in_specs=(P(None, None, "context"),
+                                   P(None, None, "context"),
+                                   P(None, None, "context"),
+                                   P(None, "context")),
+                         out_specs=P(None, None, "context"),
+                         check_vma=False)(q, k, v, sid)
+
+    ref = mha_reference(q, k, v, causal=True, segment_ids_q=sid,
+                        segment_ids_kv=sid)
+    out = run(q, k, v, sid, q_only=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+    # q-only ids must default kv ids BEFORE the ring (so they travel);
+    # a per-kernel-call default would mask visiting chunks with the
+    # stationary local q ids (review r3 finding)
+    out_q = run(q, k, v, sid, q_only=True)
+    np.testing.assert_allclose(np.asarray(out_q), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+    ps.destroy_model_parallel()
+
+
+def test_zigzag_ring_long_seq_memory_flat():
+    """At s_local=4096 (global 32k over cp=8), with dropout active, no
+    intermediate anywhere in the fwd+bwd jaxpr reaches [s_local,
+    s_local] — the tape holds O(s_local) residuals and the kernels work
+    in O(block) VMEM transients (VERDICT r2 next #3)."""
+    from apex_tpu.transformer.ring_attention import (
+        zigzag_ring_self_attention)
+
+    cp = 8
+    mesh = _setup(cp)
+    b, h, s_local, d = 1, 1, 4096, 8
+
+    def loss(q, k, v):
+        def inner(q, k, v):
+            o = zigzag_ring_self_attention(q, k, v, dropout_rate=0.1,
+                                           dropout_seed=3)
+            return jax.lax.psum(jnp.sum(o), "context")
+        return shard_map(inner, mesh=mesh,
+                         in_specs=tuple(P(None, None, "context")
+                                        for _ in range(3)),
+                         out_specs=P(), check_vma=False)(q, k, v)
+
+    q = jax.ShapeDtypeStruct((b, h, s_local, d), jnp.float32)
+    sizes = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            for var in eqn.outvars:
+                if hasattr(var, "aval") and getattr(var.aval, "shape", None) is not None:
+                    sizes.append(int(np.prod(var.aval.shape or (1,))))
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+                if isinstance(sub, (list, tuple)):
+                    for s_ in sub:
+                        if hasattr(s_, "jaxpr"):
+                            walk(s_.jaxpr)
+
+    walk(jax.make_jaxpr(jax.grad(loss, (0, 1, 2)))(q, q, q).jaxpr)
+    # biggest allowed: one kernel block transient (block_q x block_k at
+    # the default 1024, clamped to half=2048) — far below s_local^2
+    assert max(sizes) <= 2048 * 2048, max(sizes)
+    assert max(sizes) < s_local * s_local, max(sizes)
+    ps.destroy_model_parallel()
+
+
+def test_gpt_under_context_parallel_matches_single_device():
+    """GPT with the context axis bound routes attention through the
+    zigzag ring and indexes wpe by global zigzag positions: loss and
+    grads at cp=4 must match the single-device model on the full
+    sequence. Replicated-param grads are per-rank partials and reduce
+    with pmean over cp (same convention as dp: local-mean losses,
+    mean-reduced grads)."""
+    from apex_tpu.models import GPT, GPTConfig
+    from apex_tpu.transformer.ring_attention import zigzag_split
+
+    cp = 4
+    ps.destroy_model_parallel()
+    mesh = ps.initialize_model_parallel(context_parallel_size_=cp,
+                                        devices=jax.devices()[:cp])
+    cfg = GPTConfig(vocab_size=64, max_seq_len=32, hidden_size=32,
+                    num_layers=2, num_heads=4, dtype=jnp.float32)
+    model = GPT(cfg)
+    rng = np.random.RandomState(31)
+    ids = jnp.asarray(rng.randint(0, 64, (2, 32)))
+    labels = jnp.asarray(rng.randint(0, 64, (2, 32)))
+
+    def run_cp(ids, labels):
+        idsz = zigzag_split(ids, cp, axis=1)
+        labz = zigzag_split(labels, cp, axis=1)
+
+        def inner(ids, labels):
+            v = model.init(jax.random.PRNGKey(0), ids)
+            loss, g = jax.value_and_grad(
+                lambda v: jax.lax.pmean(model.loss(v, ids, labels),
+                                        "context"))(v)
+            return loss, jax.lax.pmean(g, "context")
+
+        return shard_map(inner, mesh=mesh,
+                         in_specs=(P(None, "context"), P(None, "context")),
+                         out_specs=(P(), P()), check_vma=False)(idsz, labz)
+
+    loss_cp, g_cp = jax.jit(run_cp)(ids, labels)
+
+    ps.destroy_model_parallel()
+    v = model.init(jax.random.PRNGKey(0), ids)
+    loss_ref, g_ref = jax.value_and_grad(
+        lambda v: model.loss(v, ids, labels))(v)
+
+    np.testing.assert_allclose(float(loss_cp), float(loss_ref), rtol=1e-5)
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(g_ref)[0],
+            jax.tree_util.tree_flatten_with_path(g_cp)[0]):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-4, atol=2e-5, err_msg=str(pa))
+
+
+def test_gpt_attention_dropout_under_context_parallel():
+    """VERDICT r2 next #3 done-criterion: a GPT with attention_dropout
+    (and hidden_dropout) > 0 trains under cp — in-kernel ring dropout,
+    finite loss and grads."""
+    from apex_tpu.models import GPT, GPTConfig
+    from apex_tpu.transformer.ring_attention import zigzag_split
+
+    cp = 4
+    ps.destroy_model_parallel()
+    mesh = ps.initialize_model_parallel(context_parallel_size_=cp,
+                                        devices=jax.devices()[:cp])
+    cfg = GPTConfig(vocab_size=64, max_seq_len=32, hidden_size=32,
+                    num_layers=2, num_heads=4, dtype=jnp.float32,
+                    attention_dropout=0.2, hidden_dropout=0.1)
+    model = GPT(cfg)
+    rng = np.random.RandomState(33)
+    idsz = zigzag_split(jnp.asarray(rng.randint(0, 64, (2, 32))), cp, axis=1)
+    labz = zigzag_split(jnp.asarray(rng.randint(0, 64, (2, 32))), cp, axis=1)
+
+    def inner(ids, labels):
+        v = model.init(jax.random.PRNGKey(0), ids)
+
+        def loss_fn(v):
+            from apex_tpu.transformer.tensor_parallel import (
+                vocab_parallel_cross_entropy)
+            logits = model.apply(v, ids, deterministic=False,
+                                 rngs={"dropout": jax.random.PRNGKey(5)})
+            return jax.lax.pmean(
+                jnp.mean(vocab_parallel_cross_entropy(logits, labels)),
+                "context")
+
+        loss, g = jax.value_and_grad(loss_fn)(v)
+        return loss, jax.lax.pmean(g, "context")
+
+    loss, g = jax.jit(shard_map(
+        inner, mesh=mesh, in_specs=(P(None, "context"), P(None, "context")),
+        out_specs=(P(), P()), check_vma=False))(idsz, labz)
+    assert np.isfinite(float(loss)), loss
+    for leaf in jax.tree.leaves(g):
+        assert np.isfinite(np.asarray(leaf)).all()
     ps.destroy_model_parallel()
