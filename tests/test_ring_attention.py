@@ -47,7 +47,9 @@ def test_ring_attention_causal():
 
 
 def test_ring_attention_grads():
-    mesh = _setup()
+    # cp=2 exercises both cond branches (self-chunk causal at t=0,
+    # live/skip at t=1) at half the single-core trace cost of cp=4
+    mesh = _setup(2)
     q, k, v = _qkv(b=1, h=2, s=32, d=4, seed=2)
 
     def loss_ring(q, k, v):
@@ -79,7 +81,7 @@ def test_ulysses_attention():
 
 def test_ring_attention_grads_noncausal():
     """Non-causal backward (second ring pass, traveling dk/dv accumulators)."""
-    mesh = _setup(4)
+    mesh = _setup(2)
     q, k, v = _qkv(b=1, h=2, s=32, d=4, seed=3)
 
     def loss_ring(q, k, v):
@@ -170,7 +172,7 @@ def test_zigzag_ring_matches_reference_causal():
 def test_zigzag_ring_grads():
     from apex_tpu.transformer.ring_attention import (
         zigzag_merge, zigzag_ring_self_attention, zigzag_split)
-    cp = 4
+    cp = 2
     mesh = _setup(cp)
     q, k, v = _qkv(b=1, h=2, s=64, d=4, seed=12)
 

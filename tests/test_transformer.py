@@ -323,38 +323,11 @@ def test_pipeline_interleaved_validation_and_dispatch():
     ps.destroy_model_parallel()
 
 
-def test_gpt_sequence_parallel_matches_plain_tp():
-    """Megatron-SP GPT (sequence-sharded activations between blocks) must
-    equal the plain-TP forward at tp=4."""
-    from apex_tpu.models import GPT, GPTConfig
-
-    ps.destroy_model_parallel()
-    mesh = ps.initialize_model_parallel(tensor_model_parallel_size_=4)
-    kw = dict(vocab_size=64, max_seq_len=32, hidden_size=32,
-              num_layers=2, num_heads=4, dtype=jnp.float32,
-              attention_impl="fused_softmax")
-    ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 32)))
-
-    def run(model):
-        def inner(ids):
-            v = model.init(jax.random.PRNGKey(0), ids)
-            logits = model.apply(v, ids)
-            # vocab-parallel logits: gather for comparison
-            return jax.lax.all_gather(logits, "tensor", axis=-1, tiled=True)
-        return shard_map(inner, mesh=mesh, in_specs=(P(),),
-                         out_specs=P(), check_vma=False)(ids)
-
-    out_tp = run(GPT(GPTConfig(**kw)))
-    out_sp = run(GPT(GPTConfig(**kw, sequence_parallel=True)))
-    np.testing.assert_allclose(np.asarray(out_sp), np.asarray(out_tp),
-                               rtol=2e-5, atol=2e-5)
-    ps.destroy_model_parallel()
-
-
 def test_gpt_sequence_parallel_grads_match_plain_tp():
     """The SP backward path (reduce-scatter gather VJP + tensor-axis
-    reduction of LN/bias partials) must reproduce plain-TP gradients —
-    the forward-only test cannot catch a broken grad path (review r2)."""
+    reduction of LN/bias partials) must reproduce plain-TP gradients.
+    Loss parity here also covers the forward (the former forward-only
+    test was deleted: single-core tracing cost, review r3)."""
     from apex_tpu.models import GPT, GPTConfig
     from apex_tpu.transformer.tensor_parallel import mappings as tpm
 
@@ -547,9 +520,9 @@ def test_gpt_sequence_parallel_moe_grads_match_plain_tp():
     ps.destroy_model_parallel()
     mesh = ps.initialize_model_parallel(tensor_model_parallel_size_=4)
     kw = dict(vocab_size=64, max_seq_len=32, hidden_size=32,
-              num_layers=2, num_heads=4, dtype=jnp.float32,
+              num_layers=1, num_heads=4, dtype=jnp.float32,
               attention_impl="fused_softmax", moe_num_experts=4,
-              moe_top_k=2)
+              moe_every=1, moe_top_k=2)
     rng = np.random.RandomState(7)
     ids = jnp.asarray(rng.randint(0, 64, (2, 32)))
     labels = jnp.asarray(np.roll(np.asarray(ids), -1, 1))
@@ -666,7 +639,8 @@ def test_bert_sequence_parallel_grads_match_plain_tp():
     ps.destroy_model_parallel()
     mesh = ps.initialize_model_parallel(tensor_model_parallel_size_=4)
     kw = dict(vocab_size=64, max_seq_len=16, hidden_size=32,
-              num_layers=1, num_heads=4, dtype=jnp.float32)
+              num_layers=1, num_heads=4, dtype=jnp.float32,
+              use_flash=False)
     rng = np.random.RandomState(3)
     ids = jnp.asarray(rng.randint(0, 64, (2, 16)))
     labels = jnp.asarray(rng.randint(0, 64, (2, 16)))
