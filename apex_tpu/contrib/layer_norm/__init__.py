@@ -1,0 +1,34 @@
+"""apex.contrib.layer_norm parity surface.
+
+Reference: ``apex/contrib/layer_norm/layer_norm.py`` — ``FastLayerNorm``
+(hidden_size, eps) over the ``fast_layer_norm`` CUDA extension
+(``ln_fwd``/``ln_bwd``, ``apex/contrib/csrc/layer_norm/``), apex's
+second, faster LN for large hidden sizes.
+
+TPU disposition (measured, r2): a second LN implementation buys nothing
+here — the custom-VJP LayerNorm in ``apex_tpu.ops.layer_norm`` already
+matches a hand-written Pallas LN standalone and beats it in-model (XLA
+fuses the jnp composition with its neighbors; see docs/perf.md). This
+module therefore re-exports the one implementation under the reference's
+``FastLayerNorm`` module API so ported code imports unchanged.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.normalization.fused_layer_norm import FusedLayerNorm
+from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+
+
+def FastLayerNorm(hidden_size, eps: float = 1e-5, **kw) -> FusedLayerNorm:
+    """``FastLayerNorm(hidden_size, eps)`` (reference ``layer_norm.py:31``)
+    — same params (weight=ones, bias=zeros) and forward contract as the
+    CUDA module, backed by the single fused LN implementation (factory,
+    since flax modules are frozen dataclasses)."""
+    return FusedLayerNorm(normalized_shape=hidden_size, eps=eps, **kw)
+
+
+def ln_fwd(x, gamma, beta, epsilon: float = 1e-5):
+    """Functional fwd (the ``fast_layer_norm.ln_fwd`` entry): returns the
+    normalized output (row stats are autodiff residuals here, not
+    caller-managed)."""
+    return fused_layer_norm_affine(x, gamma, beta, gamma.shape, epsilon)

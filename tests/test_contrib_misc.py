@@ -182,3 +182,24 @@ def test_spatial_bottleneck_matches_unsharded():
                           out_specs=P(None, "data"), check_vma=False)(x)
     np.testing.assert_allclose(np.asarray(y_sharded), np.asarray(y_full),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_contrib_fast_layer_norm_parity_surface():
+    """apex.contrib.layer_norm API shim: FastLayerNorm(hidden, eps) ==
+    the one fused LN (the second-LN fold is deliberate, docs/perf.md)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from apex_tpu.contrib.layer_norm import FastLayerNorm, ln_fwd
+
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 32), jnp.float32)
+    m = FastLayerNorm(32)
+    v = m.init(jax.random.PRNGKey(0), x)
+    y = m.apply(v, x)
+    ref = (x - x.mean(-1, keepdims=True)) / jnp.sqrt(
+        x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    y2 = ln_fwd(x, jnp.ones((32,)), jnp.zeros((32,)))
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
