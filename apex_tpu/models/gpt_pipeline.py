@@ -140,15 +140,15 @@ class PipelinedGPT:
         head_p = self.head.init(k_head, h0)["params"]
         rank = ps.get_pipeline_model_parallel_rank()
         L = self.layers_per_stage
-        chunks = []
-        for c in range(self.n_chunks):
-            stage = c * self.pp + rank  # traced under shard_map: fold_in
-            layer_ps = [
-                self.block.init(
-                    self._block_key(k_blocks, stage * L + l), h0)["params"]
-                for l in range(L)]
-            chunks.append(jax.tree.map(lambda *xs: jnp.stack(xs), *layer_ps))
-        chunk_p = jax.tree.map(lambda *xs: jnp.stack(xs), *chunks)
+        # global layer index of (chunk c, layer l) on this rank:
+        # (c*pp + rank)*L + l — traced under shard_map; one vmapped init
+        # produces the stacked [V, L, ...] leaves directly (a python
+        # init-per-layer loop traces the block V*L times)
+        layer_idx = ((jnp.arange(self.n_chunks)[:, None] * self.pp + rank)
+                     * L + jnp.arange(L)[None, :])
+        chunk_p = jax.vmap(jax.vmap(
+            lambda g: self.block.init(self._block_key(k_blocks, g),
+                                      h0)["params"]))(layer_idx)
         return {"embed": embed_p, "chunks": chunk_p, "head": head_p}
 
     # -- forward/backward --------------------------------------------------

@@ -90,11 +90,6 @@ def _gviz_tables(raw) -> List[List[dict]]:
     return out
 
 
-def _gviz_rows(raw) -> List[dict]:
-    """All tables flattened — kept for callers that want the raw rows."""
-    return [r for t in _gviz_tables(raw) for r in t]
-
-
 def op_stats_from_raw(raw, host: bool = False, include_idle: bool = False,
                       top: Optional[int] = None) -> List[dict]:
     """:func:`op_stats` on already-converted ``framework_op_stats``
@@ -166,20 +161,24 @@ def top_ops(logdir: str, n: int = 5, host: bool = False) -> List[list]:
 
 
 def format_table(rows: List[dict], max_rows: int = 20) -> str:
-    """Render rows as the markdown table used in docs/perf.md."""
-    hdr = ("| op | type | n | self ms | dev % | bound by | GF/s | GB/s |\n"
+    """Render rows as the markdown table used in docs/perf.md. The share
+    column is computed from the rows' self-times (same policy as
+    :func:`top_ops` — xprof's own percent column is unreliable)."""
+    total = sum(float(r.get("total_self_time_us") or 0.0)
+                for r in rows) or 1.0
+    hdr = ("| op | type | n | self ms | self % | bound by | GF/s | GB/s |\n"
            "|---|---|---|---|---|---|---|---|")
     lines = [hdr]
     for r in rows[:max_rows]:
+        self_us = float(r.get("total_self_time_us") or 0.0)
         lines.append(
             "| {op} | {ty} | {n} | {ms:.3f} | {pct:.1f} | {bb} | {fr:.1f} "
             "| {bw:.1f} |".format(
                 op=str(r.get("operation"))[:48],
                 ty=r.get("op_type") or "",
                 n=int(r.get("occurrences") or 0),
-                ms=float(r.get("total_self_time_us") or 0.0) / 1000.0,
-                pct=float(r.get("device_self_time_pct")
-                          or r.get("host_self_time_pct") or 0.0),
+                ms=self_us / 1000.0,
+                pct=100.0 * self_us / total,
                 bb=r.get("bound_by") or "",
                 fr=float(r.get("measured_flop_rate") or 0.0) / 1e9,
                 bw=float(r.get("measured_memory_bw_gbps") or 0.0)))

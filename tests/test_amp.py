@@ -200,17 +200,8 @@ class _PlainFlaxNet(nn.Module):
 
 
 def _collect_dots(fn, *args):
-    dots = []
-
-    def walk(jx):
-        for eqn in jx.eqns:
-            if eqn.primitive.name == "dot_general":
-                dots.append(tuple(iv.aval.dtype for iv in eqn.invars))
-            for sub in eqn.params.values():
-                if hasattr(sub, "jaxpr"):
-                    walk(sub.jaxpr)
-    walk(jax.make_jaxpr(fn)(*args).jaxpr)
-    return dots
+    from tests.jaxpr_utils import dot_operand_dtypes
+    return dot_operand_dtypes(jax.make_jaxpr(fn)(*args).jaxpr)
 
 
 def test_o1_default_coverage_plain_flax():

@@ -145,16 +145,8 @@ def test_rnn_o1_autocast_casts_matmuls():
         with autocast(True, jnp.bfloat16):
             return cell(p, carry, x)
 
-    dots = []
-
-    def walk(jx):
-        for eqn in jx.eqns:
-            if eqn.primitive.name == "dot_general":
-                dots.append(tuple(iv.aval.dtype for iv in eqn.invars))
-            for sub in eqn.params.values():
-                if hasattr(sub, "jaxpr"):
-                    walk(sub.jaxpr)
-    walk(jax.make_jaxpr(run)(p, carry, x).jaxpr)
+    from tests.jaxpr_utils import dot_operand_dtypes
+    dots = dot_operand_dtypes(jax.make_jaxpr(run)(p, carry, x).jaxpr)
     assert dots and all(d == (jnp.bfloat16, jnp.bfloat16) for d in dots)
 
     (h, c), y = run(p, carry, x)

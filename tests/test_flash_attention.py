@@ -259,23 +259,9 @@ def test_flash_backward_memory_flat_in_seqlen():
         def f(q, k, v):
             return jnp.sum(flash_attention(q, k, v, causal=True))
 
-        jaxpr = jax.make_jaxpr(jax.grad(f, (0, 1, 2)))(q, k, v)
-        sizes = []
-
-        def walk(jx):
-            for eqn in jx.eqns:
-                for var in eqn.outvars:
-                    if hasattr(var, "aval") and hasattr(var.aval, "shape"):
-                        sizes.append(int(np.prod(var.aval.shape or (1,))))
-                for sub in eqn.params.values():
-                    if hasattr(sub, "jaxpr"):
-                        walk(sub.jaxpr)
-                    if isinstance(sub, (list, tuple)):
-                        for s_ in sub:
-                            if hasattr(s_, "jaxpr"):
-                                walk(s_.jaxpr)
-        walk(jaxpr.jaxpr)
-        return max(sizes)
+        from tests.jaxpr_utils import max_intermediate_size
+        return max_intermediate_size(
+            jax.make_jaxpr(jax.grad(f, (0, 1, 2)))(q, k, v).jaxpr)
 
     small = biggest_intermediate(256)
     big = biggest_intermediate(1024)
