@@ -30,11 +30,15 @@ Structure (per pipeline rank, SPMD under ``shard_map``):
   the pipelined flagship uses an untied head, which is how most modern
   deployments run.)
 
-Not composed here (explicitly rejected): ``sequence_parallel`` (the
-per-block SP gather/scatter assumes seq-sharded activations between
-blocks, but pipeline transport carries the full sequence) and MoE
-(expert-axis all_to_all inside a scanned pipeline tick is untested);
-both raise.
+Sequence parallelism composes: with ``cfg.sequence_parallel`` the
+activations entering the pipe are sequence-scattered over the tensor
+axis (after embed) and gathered back before the head, so every stage —
+and every ``ppermute`` hop — carries only the ``s/tp`` shard while the
+blocks run their usual SP gather/GEMM/reduce-scatter sandwich;
+``loss_and_grads`` additionally psums the SP-partial chunk grads
+(LN + post-reduce-scatter biases) over the tensor axis via
+``GPT.sequence_parallel_grad_filter``. MoE blocks are still rejected
+(expert-axis all_to_all inside a scanned pipeline tick is untested).
 """
 
 from __future__ import annotations
@@ -45,13 +49,14 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.models.gpt import GPTBlock, GPTConfig
+from apex_tpu.models.gpt import GPT, GPTBlock, GPTConfig
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.pipeline_parallel.schedules import (
     pipeline_apply_interleaved)
 from apex_tpu.transformer.tensor_parallel import (
-    ColumnParallelLinear, VocabParallelEmbedding, vocab_parallel_cross_entropy)
+    ColumnParallelLinear, VocabParallelEmbedding,
+    mappings as tp_mappings, vocab_parallel_cross_entropy)
 
 
 class _Embed(nn.Module):
@@ -74,13 +79,19 @@ class _Head(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
+        sp = ps.sequence_parallel_active(cfg.sequence_parallel)
+        # under SP the input is the sequence SHARD: ln_f is per-token, and
+        # the column layer's own SP all-gather brings the full sequence to
+        # the GEMM — exactly ONE tensor-axis reduction in backward (a
+        # pre-gather + the layer's "f" copy would psum the stream twice)
         x = FusedLayerNorm(normalized_shape=cfg.hidden_size, dtype=cfg.dtype,
                            name="ln_f")(x)
         # untied vocab-sharded LM head; logits [..., V/tp] pair with
         # vocab_parallel_cross_entropy exactly like GPT.wte.attend
         return ColumnParallelLinear(
             input_size=cfg.hidden_size, output_size=cfg.vocab_size,
-            gather_output=False, use_bias=False, name="lm_head")(x)
+            gather_output=False, use_bias=False,
+            sequence_parallel=sp, sequence_dim=1, name="lm_head")(x)
 
 
 class PipelinedGPT:
@@ -99,11 +110,6 @@ class PipelinedGPT:
 
     def __init__(self, cfg: GPTConfig, n_chunks: int,
                  axis_name: str = ps.PIPELINE_AXIS):
-        if cfg.sequence_parallel:
-            raise ValueError(
-                "PipelinedGPT does not compose with sequence_parallel "
-                "(pipeline transport carries the full sequence between "
-                "stages; per-block SP expects seq-sharded activations)")
         if cfg.moe_num_experts:
             raise ValueError("PipelinedGPT does not support MoE blocks yet")
         pp = ps.get_pipeline_model_parallel_world_size()
@@ -166,12 +172,28 @@ class PipelinedGPT:
         x = self.embed.apply({"params": params["embed"]},
                              ids_mb.reshape(nmb * mb, s))
         x = x.reshape(nmb, mb, s, self.cfg.hidden_size)
+        sp = ps.sequence_parallel_active(self.cfg.sequence_parallel)
+        if sp:
+            tp = ps.get_tensor_model_parallel_world_size()
+            if s % tp:
+                raise ValueError(
+                    f"sequence_parallel requires seq len ({s}) divisible "
+                    f"by tp ({tp})")
+            # Megatron-SP through the pipe: stages (and every ppermute
+            # hop) carry the s/tp sequence shard; blocks do their usual
+            # SP gather/reduce-scatter sandwich internally
+            x = tp_mappings.scatter_to_sequence_parallel_region(
+                x, ps.TENSOR_AXIS, 2)
         outs = pipeline_apply_interleaved(
             self.stage_fn, params["chunks"], x, nmb, self.n_chunks,
             self.axis_name)
+        # under SP, outs stay sequence-sharded: the head's ln_f runs on
+        # the shard and its column layer gathers internally (one
+        # tensor-axis reduction; see _Head)
+        s_head = outs.shape[2]
         logits = self.head.apply(
             {"params": params["head"]},
-            outs.reshape(nmb * mb, s, self.cfg.hidden_size))
+            outs.reshape(nmb * mb, s_head, self.cfg.hidden_size))
         losses = vocab_parallel_cross_entropy(
             logits, labels_mb.reshape(nmb * mb, s))
         loss = jnp.mean(losses)
@@ -199,5 +221,13 @@ class PipelinedGPT:
         grads, loss = jax.grad(full, has_aux=True)(params)
         grads["embed"] = jax.lax.psum(grads["embed"], self.axis_name)
         grads["head"] = jax.lax.psum(grads["head"], self.axis_name)
+        if ps.sequence_parallel_active(self.cfg.sequence_parallel):
+            # SP contract: in-block LN / post-reduce-scatter bias grads
+            # are per-tp-rank partials (each rank saw its token shard),
+            # and so is the head's ln_f (it runs on the sequence shard)
+            grads["chunks"] = tp_mappings.allreduce_sequence_parallel_gradients(
+                grads["chunks"], GPT.sequence_parallel_grad_filter)
+            grads["head"] = tp_mappings.allreduce_sequence_parallel_gradients(
+                grads["head"], GPT.sequence_parallel_grad_filter)
         loss = jax.lax.psum(loss, self.axis_name)
         return loss, grads
