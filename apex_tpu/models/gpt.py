@@ -86,6 +86,17 @@ class GPTConfig:
         return self.ffn_hidden_size or 4 * self.hidden_size
 
 
+def moe_aux_sum(intermediates):
+    """Sum of the ``moe_aux`` sows in an ``intermediates`` collection —
+    selecting ONLY that key, so other sown intermediates (e.g. future
+    diagnostics) never leak into the training objective. Shared by
+    ``GPT.loss`` and the pipelined stage function."""
+    return sum(
+        leaf for path, leaf in jax.tree_util.tree_flatten_with_path(
+            intermediates)[0]
+        if any(getattr(k, "key", None) == "moe_aux" for k in path))
+
+
 class ParallelSelfAttention(nn.Module):
     cfg: GPTConfig
 
@@ -358,15 +369,9 @@ class GPT(nn.Module):
                                      mutable=["intermediates"])
             ce = jnp.mean(vocab_parallel_cross_entropy(logits, labels))
             # summed over MoE layers (Switch/GShard sum per-layer aux so
-            # load-balancing pressure is depth-independent per layer);
-            # select only the moe_aux sows — other intermediates (e.g.
-            # future diagnostics) must not leak into the objective
-            auxes = [leaf
-                     for path, leaf in jax.tree_util.tree_flatten_with_path(
-                         mut["intermediates"])[0]
-                     if any(getattr(k, "key", None) == "moe_aux"
-                            for k in path)]
-            return ce + self.cfg.moe_aux_coeff * sum(auxes)
+            # load-balancing pressure is depth-independent per layer)
+            return ce + self.cfg.moe_aux_coeff * moe_aux_sum(
+                mut["intermediates"])
         logits = self.apply(variables, ids)
         losses = vocab_parallel_cross_entropy(logits, labels)
         return jnp.mean(losses)

@@ -19,10 +19,12 @@ Structure (per pipeline rank, SPMD under ``shard_map``):
   result enters the pipe, so embed grads live on rank 0 —
   ``loss_and_grads`` psums them across the pipeline axis (the Megatron
   embedding-group allreduce generalized to full replication).
-- ``chunks`` params: every leaf stacked ``[V, L, ...]`` — V chunks of L
-  blocks; the ``chunk_params`` contract of
-  ``pipeline_apply_interleaved``. The stage function ``lax.scan``s the L
-  blocks (remat applied by the schedule).
+- ``chunks`` params: dense configs stack every leaf ``[V, L, ...]`` (V
+  chunks of L identical blocks; the stage function ``lax.scan``s them).
+  MoE configs use per-slot dicts ``{"layer_l": tree}`` with ``[V, ...]``
+  leaves instead — MoE and dense blocks have different structures, so
+  slots cannot stack — and the stage function unrolls the L slots.
+  Remat is applied by the schedule either way.
 - ``head`` params (final LayerNorm + untied vocab-sharded LM head):
   replicated over pp, consumed on the last rank only, grads psummed
   like ``embed``. (Megatron's *tied* embedding needs the first+last
@@ -37,8 +39,18 @@ and every ``ppermute`` hop — carries only the ``s/tp`` shard while the
 blocks run their usual SP gather/GEMM/reduce-scatter sandwich;
 ``loss_and_grads`` additionally psums the SP-partial chunk grads
 (LN + post-reduce-scatter biases) over the tensor axis via
-``GPT.sequence_parallel_grad_filter``. MoE blocks are still rejected
-(expert-axis all_to_all inside a scanned pipeline tick is untested).
+``GPT.sequence_parallel_grad_filter``.
+
+MoE composes too: chunk params are per-slot dicts (MoE and dense blocks
+have different structures), the stage function returns the summed
+load-balancing aux alongside the hidden state, and the schedule
+accumulates aux over exactly the mask-valid units (``with_aux``) so the
+pipeline psum totals it across stages and microbatches. The dense/MoE
+pattern must be identical on every rank's slot, i.e.
+``layers_per_stage % moe_every == 0`` (validated). With the expert mesh
+axis bound, each rank's experts initialize from the same folded key —
+routing differentiates them during training (same caveat as the
+single-pipe MoE GPT under ep).
 """
 
 from __future__ import annotations
@@ -49,7 +61,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.models.gpt import GPT, GPTBlock, GPTConfig
+from apex_tpu.models.gpt import GPT, GPTBlock, GPTConfig, moe_aux_sum
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.pipeline_parallel.schedules import (
@@ -110,22 +122,39 @@ class PipelinedGPT:
 
     def __init__(self, cfg: GPTConfig, n_chunks: int,
                  axis_name: str = ps.PIPELINE_AXIS):
-        if cfg.moe_num_experts:
-            raise ValueError("PipelinedGPT does not support MoE blocks yet")
         pp = ps.get_pipeline_model_parallel_world_size()
         n_stages = pp * n_chunks
         if cfg.num_layers % n_stages:
             raise ValueError(
                 f"num_layers ({cfg.num_layers}) must divide into pp ({pp}) "
                 f"x n_chunks ({n_chunks}) = {n_stages} stages")
+        L = cfg.num_layers // n_stages
+        if cfg.moe_num_experts and L % cfg.moe_every:
+            # SPMD needs the dense/MoE pattern identical on every rank's
+            # chunk slot l: global layer (stage*L + l) % moe_every is
+            # rank-independent exactly when L % moe_every == 0
+            raise ValueError(
+                f"MoE in the pipeline needs layers_per_stage ({L}) "
+                f"divisible by moe_every ({cfg.moe_every}) so every rank "
+                f"has the same block structure per slot")
         self.cfg = cfg
         self.pp = pp
         self.n_chunks = n_chunks
-        self.layers_per_stage = cfg.num_layers // n_stages
+        self.layers_per_stage = L
         self.axis_name = axis_name
-        self.block = GPTBlock(cfg, use_moe=False)
+        # per-slot block modules: with MoE, slot l is an expert block iff
+        # its GLOBAL layer index is — which by the check above reduces to
+        # the slot-local pattern below (same on every rank)
+        self.blocks = [
+            GPTBlock(cfg, use_moe=bool(cfg.moe_num_experts)
+                     and (l % cfg.moe_every == cfg.moe_every - 1))
+            for l in range(L)]
         self.embed = _Embed(cfg)
         self.head = _Head(cfg)
+
+    @property
+    def has_moe(self) -> bool:
+        return any(b.use_moe for b in self.blocks)
 
     # -- parameters --------------------------------------------------------
 
@@ -134,11 +163,14 @@ class PipelinedGPT:
 
     def init(self, key, ids_mb):
         """Rank-aware init (call INSIDE shard_map): every rank gets the
-        replicated embed/head params plus ITS chunks' block params,
-        stacked [V, L, ...]. Block params for global stage ``c*P + r``
-        derive from ``fold_in(key, global_layer)`` so any (pp, V)
-        factorization — including pp=1 (sequential reference) — yields
-        the same logical weights."""
+        replicated embed/head params plus ITS chunks' block params —
+        ``chunks`` is ``{"layer_l": tree}`` with every leaf stacked
+        ``[V, ...]`` (per-slot dicts: MoE and dense blocks have
+        different structures, so slots cannot stack on one leaf). Block
+        params for global stage ``c*P + r`` derive from
+        ``fold_in(key, global_layer)`` so any (pp, V) factorization —
+        including pp=1 (sequential reference) — yields the same logical
+        weights."""
         mb_ids = ids_mb[0]
         k_embed, k_head, k_blocks = jax.random.split(key, 3)
         embed_p = self.embed.init(k_embed, mb_ids)["params"]
@@ -146,26 +178,49 @@ class PipelinedGPT:
         head_p = self.head.init(k_head, h0)["params"]
         rank = ps.get_pipeline_model_parallel_rank()
         L = self.layers_per_stage
-        # global layer index of (chunk c, layer l) on this rank:
-        # (c*pp + rank)*L + l — traced under shard_map; one vmapped init
-        # produces the stacked [V, L, ...] leaves directly (a python
-        # init-per-layer loop traces the block V*L times)
-        layer_idx = ((jnp.arange(self.n_chunks)[:, None] * self.pp + rank)
-                     * L + jnp.arange(L)[None, :])
-        chunk_p = jax.vmap(jax.vmap(
-            lambda g: self.block.init(self._block_key(k_blocks, g),
-                                      h0)["params"]))(layer_idx)
+        # global layer of (chunk c, slot l) on this rank: (c*pp+rank)*L+l
+        # — traced under shard_map
+        base = (jnp.arange(self.n_chunks) * self.pp + rank) * L
+        if self.has_moe:
+            # heterogeneous slots: per-slot dicts, leaves [V, ...]
+            chunk_p = {
+                f"layer_{l}": jax.vmap(
+                    lambda g, block=block: block.init(
+                        self._block_key(k_blocks, g), h0)["params"])(base + l)
+                for l, block in enumerate(self.blocks)}
+        else:
+            # homogeneous slots: one double-vmapped init -> [V, L, ...]
+            # leaves, so the stage scans instead of unrolling L blocks
+            layer_idx = base[:, None] + jnp.arange(L)[None, :]
+            chunk_p = jax.vmap(jax.vmap(
+                lambda g: self.blocks[0].init(
+                    self._block_key(k_blocks, g), h0)["params"]))(layer_idx)
         return {"embed": embed_p, "chunks": chunk_p, "head": head_p}
 
     # -- forward/backward --------------------------------------------------
 
     def stage_fn(self, chunk_params, h):
-        """One stage = L scanned GPT blocks (the schedule wraps this in
-        ``jax.checkpoint`` when remat is on)."""
-        def body(h, p):
-            return self.block.apply({"params": p}, h, True), None
-        h, _ = jax.lax.scan(body, h, chunk_params)
-        return h
+        """One stage = L GPT blocks (the schedule wraps this in
+        ``jax.checkpoint`` when remat is on). Dense: one ``lax.scan``
+        over the stacked [L, ...] params. MoE: the L slots unroll
+        (heterogeneous param structures) and the call returns
+        ``(h, aux)`` — the stage's summed load-balancing loss (only the
+        ``moe_aux`` sows; see ``moe_aux_sum``) — matching the schedule's
+        ``with_aux`` contract."""
+        if not self.has_moe:
+            def body(h, p):
+                return self.blocks[0].apply({"params": p}, h, True), None
+            h, _ = jax.lax.scan(body, h, chunk_params)
+            return h
+        aux = jnp.zeros((), jnp.float32)
+        for l, block in enumerate(self.blocks):
+            p = {"params": chunk_params[f"layer_{l}"]}
+            if block.use_moe:
+                h, mut = block.apply(p, h, True, mutable=["intermediates"])
+                aux = aux + moe_aux_sum(mut["intermediates"])
+            else:
+                h = block.apply(p, h, True)
+        return h, aux
 
     def _loss_of(self, params, ids_mb, labels_mb):
         nmb, mb, s = ids_mb.shape
@@ -184,9 +239,10 @@ class PipelinedGPT:
             # SP gather/reduce-scatter sandwich internally
             x = tp_mappings.scatter_to_sequence_parallel_region(
                 x, ps.TENSOR_AXIS, 2)
-        outs = pipeline_apply_interleaved(
+        res = pipeline_apply_interleaved(
             self.stage_fn, params["chunks"], x, nmb, self.n_chunks,
-            self.axis_name)
+            self.axis_name, with_aux=self.has_moe)
+        outs, aux = res if self.has_moe else (res, None)
         # under SP, outs stay sequence-sharded: the head's ln_f runs on
         # the shard and its column layer gathers internally (one
         # tensor-axis reduction; see _Head)
@@ -199,7 +255,13 @@ class PipelinedGPT:
         loss = jnp.mean(losses)
         rank = jax.lax.axis_index(self.axis_name)
         n_stages = jax.lax.axis_size(self.axis_name)
-        return jnp.where(rank == n_stages - 1, loss, 0.0)
+        loss = jnp.where(rank == n_stages - 1, loss, 0.0)
+        if aux is not None:
+            # each rank's aux covers ITS executed (stage, microbatch)
+            # units; the pipeline psum in loss_and_grads totals them —
+            # /nmb matches GPT.loss's per-batch aux scale
+            loss = loss + self.cfg.moe_aux_coeff * aux / nmb
+        return loss
 
     def loss_and_grads(self, params, ids_mb, labels_mb,
                        loss_scale: Optional[jax.Array] = None):

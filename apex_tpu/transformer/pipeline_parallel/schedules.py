@@ -116,7 +116,8 @@ def forward_backward_pipelining_without_interleaving(
 def pipeline_apply_interleaved(stage_fn: Callable, chunk_params, x,
                                n_microbatches: int, n_chunks: int,
                                axis_name: str = ps.PIPELINE_AXIS,
-                               remat: bool = True):
+                               remat: bool = True,
+                               with_aux: bool = False):
     """Interleaved (virtual-pipeline) schedule over the pipeline axis.
 
     Each rank holds ``n_chunks`` (= vpp) model chunks stacked on the
@@ -137,6 +138,13 @@ def pipeline_apply_interleaved(stage_fn: Callable, chunk_params, x,
     Requires ``n_microbatches % P == 0`` (the Megatron constraint).
     ``x``: [n_microbatches, mb, ...]; returns [n_microbatches, mb, ...]
     final-stage outputs (valid on the last rank).
+
+    ``with_aux``: ``stage_fn`` returns ``(h, aux_scalar)`` and the call
+    returns ``(outputs, aux_sum)`` — aux (e.g. the MoE load-balancing
+    loss) accumulated over exactly the REAL (mask-valid) units this rank
+    executed; bubble ticks contribute nothing. Summing each rank's
+    ``aux_sum`` over the pipeline axis gives the total over all stages
+    and microbatches.
     """
     n_microbatches = resolve_num_microbatches(n_microbatches)
     n_stages = jax.lax.axis_size(axis_name)
@@ -159,7 +167,7 @@ def pipeline_apply_interleaved(stage_fn: Callable, chunk_params, x,
     init_out = jnp.zeros((n_microbatches,) + h_shape, x.dtype)
 
     def tick(carry, t):
-        held, outputs = carry
+        held, outputs, aux_sum = carry
         u = t - rank                      # unit index in this rank's order
         valid = (u >= 0) & (u < V * n_microbatches)
         uc = jnp.clip(u, 0, V * n_microbatches - 1)
@@ -174,7 +182,11 @@ def pipeline_apply_interleaved(stage_fn: Callable, chunk_params, x,
         inject = jax.lax.dynamic_index_in_dim(x, m, keepdims=False)
         use_inject = valid & (c == 0) & (rank == 0)
         inp = jnp.where(use_inject, inject, held)
-        out = fn(params_c, inp)
+        if with_aux:
+            out, aux = fn(params_c, inp)
+            aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
+        else:
+            out = fn(params_c, inp)
         # collect completed microbatches on the last rank's last chunk
         done = valid & (c == V - 1) & (rank == n_stages - 1)
         updated = jax.lax.dynamic_update_index_in_dim(outputs, out, m, 0)
@@ -182,11 +194,12 @@ def pipeline_apply_interleaved(stage_fn: Callable, chunk_params, x,
         # cyclic: the last rank's chunk-c output wraps to rank 0, which
         # consumes it next tick as chunk c+1's input
         held_next = ring_shift(out, axis_name, wrap=True)
-        return (held_next, outputs), None
+        return (held_next, outputs, aux_sum), None
 
-    (_, outputs), _ = jax.lax.scan(tick, (init_held, init_out),
-                                   jnp.arange(total_ticks))
-    return outputs
+    (_, outputs, aux_sum), _ = jax.lax.scan(
+        tick, (init_held, init_out, jnp.zeros((), jnp.float32)),
+        jnp.arange(total_ticks))
+    return (outputs, aux_sum) if with_aux else outputs
 
 
 def forward_backward_pipelining_with_interleaving(
