@@ -717,12 +717,16 @@ def test_tp_train_step_never_gathers_full_vocab():
     ps.destroy_model_parallel()
 
 
-def test_pipelined_gpt_moe_matches_sequential():
+@pytest.mark.parametrize("sp", [False, True])
+def test_pipelined_gpt_moe_matches_sequential(sp):
     """MoE blocks through the interleaved pipeline (the last composition
     r2-style rejections left open): expert MLPs in every stage at
     pp=2 x vpp=2 x tp=2, load-balancing aux accumulated through the
     schedule's with_aux channel — loss and all grads must match the
-    sequential reference (ce + coeff * sum of per-layer aux)."""
+    sequential (non-SP) reference (ce + coeff * sum of per-layer aux).
+    sp=True runs the TRIPLE composition SP x MoE x interleaved-PP: the
+    MoE blocks gather the full sequence internally while the pipe
+    carries shards."""
     from apex_tpu.models import GPTConfig
     from apex_tpu.models.gpt import GPTBlock
     from apex_tpu.models.gpt_pipeline import PipelinedGPT, _Embed, _Head
@@ -744,7 +748,7 @@ def test_pipelined_gpt_moe_matches_sequential():
         tensor_model_parallel_size_=2, pipeline_model_parallel_size_=P_,
         virtual_pipeline_model_parallel_size_=V,
         devices=jax.devices()[:4])
-    pg = PipelinedGPT(cfg, n_chunks=V)
+    pg = PipelinedGPT(GPTConfig(**kw, sequence_parallel=sp), n_chunks=V)
 
     def run(ids, labels):
         params = pg.init(jax.random.PRNGKey(0), ids)
