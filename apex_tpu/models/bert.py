@@ -47,6 +47,10 @@ class BertConfig:
     # Megatron-SP (see gpt.py): activations between layers are
     # sequence-sharded over the tensor axis
     sequence_parallel: bool = False
+    # ``loss`` fuses the tied LM-head matmul into the cross entropy
+    # (``ops.lm_head_ce``; no [b, s, V] logits in HBM); False falls back
+    # to attend -> vocab_parallel_cross_entropy (numerics-debug path)
+    fused_lm_head: bool = True
 
     @property
     def ffn(self):
@@ -146,8 +150,11 @@ class Bert(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, ids, pad_mask=None, type_ids=None):
-        """Returns [b, s, V/tp] MLM logits (tied to the embedding shard)."""
+    def __call__(self, ids, pad_mask=None, type_ids=None,
+                 return_hidden: bool = False):
+        """Returns [b, s, V/tp] MLM logits (tied to the embedding shard);
+        with ``return_hidden`` the pre-LM-head hidden states instead (the
+        fused logits+CE path, see ``loss``)."""
         cfg = self.cfg  # pad_mask=None means "no padding" end-to-end
         wte = VocabParallelEmbedding(
             num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
@@ -193,7 +200,41 @@ class Bert(nn.Module):
             # Megatron "f" before the tied output embedding: bwd
             # all-reduces the per-vocab-shard partial d(x) (see gpt.py)
             x = tp_mappings.copy_to_tensor_model_parallel_region(x)
+        if return_hidden:
+            return x
         return wte.attend(x)
+
+    def loss(self, variables, ids, labels, pad_mask=None, type_ids=None,
+             label_smoothing: float = 0.0, loss_mask=None):
+        """Mean MLM cross entropy — by default via the fused LM-head+CE
+        kernel (``ops.lm_head_ce``), so the [b, s, V] logits never hit
+        HBM.
+
+        ``loss_mask``: optional bool/0-1 [b, s] selecting the positions
+        that count (MLM prediction positions / non-pad tokens); the mean
+        normalizes by the mask total, so padded positions contribute
+        neither loss nor gradient. Defaults to ``pad_mask`` when that is
+        given (padding never trains), else every position."""
+        from apex_tpu.ops.lm_head_ce import fused_lm_head_cross_entropy
+        from apex_tpu.transformer.tensor_parallel import (
+            vocab_parallel_cross_entropy)
+        if self.cfg.fused_lm_head:
+            hidden = self.apply(variables, ids, pad_mask, type_ids,
+                                return_hidden=True)
+            emb = variables["params"]["wte"]["embedding"]
+            losses = fused_lm_head_cross_entropy(
+                hidden, emb, labels, label_smoothing,
+                axis_name=ps.TENSOR_AXIS)
+        else:
+            logits = self.apply(variables, ids, pad_mask, type_ids)
+            losses = vocab_parallel_cross_entropy(
+                logits, labels, label_smoothing)
+        if loss_mask is None and pad_mask is not None:
+            loss_mask = pad_mask
+        if loss_mask is None:
+            return jnp.mean(losses)
+        w = loss_mask.astype(losses.dtype)
+        return jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1.0)
 
     @staticmethod
     def sequence_parallel_grad_filter(path_names, leaf) -> bool:

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.ops.lm_head_ce import fused_lm_head_cross_entropy
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.ring_attention import zigzag_ring_self_attention
 from apex_tpu.transformer.enums import AttnMaskType
@@ -76,6 +77,13 @@ class GPTConfig:
     moe_top_k: int = 2                       # 1 = switch, 2 = GShard
     moe_capacity_factor: float = 1.25
     moe_aux_coeff: float = 0.01
+    # ``loss`` computes the LM-head matmul and the cross entropy in one
+    # Pallas kernel family (``ops.lm_head_ce``) that never materializes
+    # the [b, s, V] logits — the step's largest tensor — in HBM. The
+    # unfused path (attend -> vocab_parallel_cross_entropy) remains as
+    # the numerics-debug/GSPMD route; ``__call__`` (inference logits) is
+    # unaffected either way.
+    fused_lm_head: bool = True
 
     def __post_init__(self):
         if self.moe_num_experts and self.moe_every < 1:
@@ -299,7 +307,8 @@ class GPT(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, ids, deterministic: bool = True):
+    def __call__(self, ids, deterministic: bool = True,
+                 return_hidden: bool = False):
         cfg = self.cfg
         wte = VocabParallelEmbedding(
             num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
@@ -359,22 +368,35 @@ class GPT(nn.Module):
             # this, wpe/wte/ln_f and the whole residual stream get 1/tp
             # of their gradient (r1 bug, caught by an SP FD check)
             x = tp_mappings.copy_to_tensor_model_parallel_region(x)
+        if return_hidden:
+            # pre-LM-head hidden states for the fused logits+CE path
+            # (``loss``); the "f"/SP-gather above already ran, so the
+            # fused op's per-vocab-shard dx partial meets the same
+            # backward all-reduce as the unfused logits did
+            return x
         # vocab-parallel logits, tied to the embedding shard
         logits = wte.attend(x)
         return logits  # [b, s, V/tp] (full V at tp=1)
 
+    def _ce(self, variables, hidden_or_logits, labels):
+        if self.cfg.fused_lm_head:
+            emb = variables["params"]["wte"]["embedding"]
+            return fused_lm_head_cross_entropy(
+                hidden_or_logits, emb, labels, axis_name=ps.TENSOR_AXIS)
+        return vocab_parallel_cross_entropy(hidden_or_logits, labels)
+
     def loss(self, variables, ids, labels):
+        fused = self.cfg.fused_lm_head
         if self.cfg.moe_num_experts:
-            logits, mut = self.apply(variables, ids,
-                                     mutable=["intermediates"])
-            ce = jnp.mean(vocab_parallel_cross_entropy(logits, labels))
+            out, mut = self.apply(variables, ids, return_hidden=fused,
+                                  mutable=["intermediates"])
+            ce = jnp.mean(self._ce(variables, out, labels))
             # summed over MoE layers (Switch/GShard sum per-layer aux so
             # load-balancing pressure is depth-independent per layer)
             return ce + self.cfg.moe_aux_coeff * moe_aux_sum(
                 mut["intermediates"])
-        logits = self.apply(variables, ids)
-        losses = vocab_parallel_cross_entropy(logits, labels)
-        return jnp.mean(losses)
+        out = self.apply(variables, ids, return_hidden=fused)
+        return jnp.mean(self._ce(variables, out, labels))
 
     @staticmethod
     def sequence_parallel_grad_filter(path_names, leaf) -> bool:

@@ -63,6 +63,7 @@ import jax.numpy as jnp
 
 from apex_tpu.models.gpt import GPT, GPTBlock, GPTConfig, moe_aux_sum
 from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.ops.lm_head_ce import fused_lm_head_cross_entropy
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.pipeline_parallel.schedules import (
     pipeline_apply_interleaved)
@@ -89,7 +90,7 @@ class _Head(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, hidden_only: bool = False):
         cfg = self.cfg
         sp = ps.sequence_parallel_active(cfg.sequence_parallel)
         # under SP the input is the sequence SHARD: ln_f is per-token, and
@@ -98,6 +99,18 @@ class _Head(nn.Module):
         # pre-gather + the layer's "f" copy would psum the stream twice)
         x = FusedLayerNorm(normalized_shape=cfg.hidden_size, dtype=cfg.dtype,
                            name="ln_f")(x)
+        if hidden_only:
+            # fused-CE route: reproduce the column layer's stream handling
+            # (its gather under SP, its "f" copy otherwise) and hand the
+            # full-sequence hidden states to ``fused_lm_head_cross_entropy``,
+            # which consumes the lm_head weight directly — still exactly
+            # ONE tensor-axis reduction in backward
+            if sp:
+                x = tp_mappings.gather_from_sequence_parallel_region(
+                    x, ps.TENSOR_AXIS, 1)
+            elif ps.get_tensor_model_parallel_world_size() > 1:
+                x = tp_mappings.copy_to_tensor_model_parallel_region(x)
+            return x
         # untied vocab-sharded LM head; logits [..., V/tp] pair with
         # vocab_parallel_cross_entropy exactly like GPT.wte.attend
         return ColumnParallelLinear(
@@ -247,11 +260,24 @@ class PipelinedGPT:
         # the shard and its column layer gathers internally (one
         # tensor-axis reduction; see _Head)
         s_head = outs.shape[2]
-        logits = self.head.apply(
-            {"params": params["head"]},
-            outs.reshape(nmb * mb, s_head, self.cfg.hidden_size))
-        losses = vocab_parallel_cross_entropy(
-            logits, labels_mb.reshape(nmb * mb, s))
+        if self.cfg.fused_lm_head:
+            hidden = self.head.apply(
+                {"params": params["head"]},
+                outs.reshape(nmb * mb, s_head, self.cfg.hidden_size),
+                hidden_only=True)
+            # lm_head kernel is [h, V/tp]; the fused op takes the table
+            # [V/tp, h] — the transpose is one cheap pass, its autodiff
+            # routes dE back to the kernel layout
+            w = params["head"]["lm_head"]["kernel"].T
+            losses = fused_lm_head_cross_entropy(
+                hidden, w, labels_mb.reshape(nmb * mb, s),
+                axis_name=ps.TENSOR_AXIS)
+        else:
+            logits = self.head.apply(
+                {"params": params["head"]},
+                outs.reshape(nmb * mb, s_head, self.cfg.hidden_size))
+            losses = vocab_parallel_cross_entropy(
+                logits, labels_mb.reshape(nmb * mb, s))
         loss = jnp.mean(losses)
         rank = jax.lax.axis_index(self.axis_name)
         n_stages = jax.lax.axis_size(self.axis_name)

@@ -182,9 +182,14 @@ def _ring_bwd_impl(res, do, axis_name, causal, scale, dropout_rate,
             dk_cur = dk_cur + g[1].astype(jnp.float32)
             dv_cur = dv_cur + g[2].astype(jnp.float32)
         # dk/dv accumulators travel with their kv chunk; after cp
-        # permutes every chunk (and its grads) is back home
-        k_cur, v_cur, sk_cur, dk_cur, dv_cur = _permute(
-            (k_cur, v_cur, sk_cur, dk_cur, dv_cur), axis_name, perm)
+        # permutes every chunk (and its grads) is back home — the final
+        # hop carries ONLY the accumulators (k/v/sids would arrive home
+        # unused: 2-3 dead chunk transfers per layer, advisor r3)
+        if t < cp - 1:
+            k_cur, v_cur, sk_cur, dk_cur, dv_cur = _permute(
+                (k_cur, v_cur, sk_cur, dk_cur, dv_cur), axis_name, perm)
+        else:
+            dk_cur, dv_cur = _permute((dk_cur, dv_cur), axis_name, perm)
     return (dq.astype(q.dtype), dk_cur.astype(k.dtype),
             dv_cur.astype(v.dtype))
 
@@ -489,8 +494,13 @@ def _zz_bwd_impl(res, do, axis_name, scale, dropout_rate, block_q, block_k):
 
         dk_cur = jnp.concatenate([dk0, dk1], axis=2)
         dv_cur = jnp.concatenate([dv0, dv1], axis=2)
-        k_cur, v_cur, skv_cur, dk_cur, dv_cur = _permute(
-            (k_cur, v_cur, skv_cur, dk_cur, dv_cur), axis_name, perm)
+        # final hop: only the dk/dv accumulators still need to travel
+        # home (k/v/sids would arrive unused — advisor r3)
+        if t < cp - 1:
+            k_cur, v_cur, skv_cur, dk_cur, dv_cur = _permute(
+                (k_cur, v_cur, skv_cur, dk_cur, dv_cur), axis_name, perm)
+        else:
+            dk_cur, dv_cur = _permute((dk_cur, dv_cur), axis_name, perm)
 
     dq = jnp.concatenate([dq0, dq1], axis=2)
     return (dq.astype(q.dtype), dk_cur.astype(k.dtype),

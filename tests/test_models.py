@@ -185,3 +185,81 @@ def test_gpt_dropout_with_remat():
     out = m.apply(v, ids, deterministic=False,
                   rngs={"dropout": jax.random.PRNGKey(1)})
     assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.parametrize("moe", [False, True])
+def test_gpt_loss_fused_lm_head_matches_unfused(moe):
+    """``GPTConfig.fused_lm_head`` (Pallas logits+CE, no [b,s,V] in HBM)
+    equals the attend -> vocab_parallel_cross_entropy composition, in
+    loss and in every parameter gradient."""
+    kw = dict(vocab_size=96, max_seq_len=16, hidden_size=32, num_layers=2,
+              num_heads=2, dtype=jnp.float32)
+    if moe:
+        kw.update(moe_num_experts=2, moe_every=2)
+    m_fused = GPT(GPTConfig(fused_lm_head=True, **kw))
+    m_ref = GPT(GPTConfig(fused_lm_head=False, **kw))
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 96, (2, 8)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, 96, (2, 8)), jnp.int32)
+    v = m_ref.init(jax.random.PRNGKey(0), ids)
+
+    l_f, g_f = jax.value_and_grad(lambda v: m_fused.loss(v, ids, labels))(v)
+    l_r, g_r = jax.value_and_grad(lambda v: m_ref.loss(v, ids, labels))(v)
+    np.testing.assert_allclose(float(l_f), float(l_r), rtol=1e-5, atol=1e-6)
+    flat_f = jax.tree_util.tree_leaves_with_path(g_f)
+    flat_r = dict(jax.tree_util.tree_leaves_with_path(g_r))
+    for path, leaf in flat_f:
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_r[path]), rtol=2e-4,
+            atol=2e-5, err_msg=jax.tree_util.keystr(path))
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_bert_loss_fused_lm_head_matches_unfused(smoothing):
+    """``Bert.loss`` fused vs attend->CE parity (loss + grads), incl.
+    label smoothing and the masked-mean path."""
+    kw = dict(vocab_size=96, max_seq_len=16, hidden_size=32, num_layers=2,
+              num_heads=2, dtype=jnp.float32, use_flash=False)
+    from apex_tpu.models.bert import BertConfig as BC
+    m_f = Bert(BC(fused_lm_head=True, **kw))
+    m_r = Bert(BC(fused_lm_head=False, **kw))
+    rs = np.random.RandomState(7)
+    ids = jnp.asarray(rs.randint(0, 96, (2, 8)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, 96, (2, 8)), jnp.int32)
+    mask = jnp.asarray(rs.rand(2, 8) > 0.3)
+    v = m_r.init(jax.random.PRNGKey(0), ids)
+
+    def lf(v):
+        return m_f.loss(v, ids, labels, label_smoothing=smoothing,
+                        loss_mask=mask)
+
+    def lr(v):
+        return m_r.loss(v, ids, labels, label_smoothing=smoothing,
+                        loss_mask=mask)
+
+    l_f, g_f = jax.value_and_grad(lf)(v)
+    l_r, g_r = jax.value_and_grad(lr)(v)
+    np.testing.assert_allclose(float(l_f), float(l_r), rtol=1e-5, atol=1e-6)
+    flat_r = dict(jax.tree_util.tree_leaves_with_path(g_r))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(g_f):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_r[path]), rtol=2e-4,
+            atol=2e-5, err_msg=jax.tree_util.keystr(path))
+
+
+def test_bert_loss_mask_ignores_padding():
+    """Masked-out positions contribute neither loss nor gradient."""
+    from apex_tpu.models.bert import BertConfig as BC
+    m = Bert(BC(vocab_size=64, max_seq_len=16, hidden_size=32,
+                num_layers=1, num_heads=2, dtype=jnp.float32,
+                use_flash=False))
+    rs = np.random.RandomState(8)
+    ids = jnp.asarray(rs.randint(0, 64, (1, 8)), jnp.int32)
+    labels1 = jnp.asarray(rs.randint(0, 64, (1, 8)), jnp.int32)
+    # change labels ONLY where the mask is off — loss must not move
+    mask = jnp.asarray([[True] * 5 + [False] * 3])
+    labels2 = labels1.at[0, 5:].set((labels1[0, 5:] + 7) % 64)
+    v = m.init(jax.random.PRNGKey(0), ids)
+    l1 = float(m.loss(v, ids, labels1, loss_mask=mask))
+    l2 = float(m.loss(v, ids, labels2, loss_mask=mask))
+    assert l1 == l2
