@@ -42,6 +42,7 @@ from apex_tpu import fp16_utils  # noqa: F401
 from apex_tpu import reparameterization  # noqa: F401
 from apex_tpu import rnn  # noqa: F401
 from apex_tpu import pyprof  # noqa: F401
+from apex_tpu import checkpoint  # noqa: F401
 
 # heavier subpackages (transformer, contrib, models) import on demand:
 #   import apex_tpu.transformer / apex_tpu.contrib / apex_tpu.models

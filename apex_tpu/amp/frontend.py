@@ -43,6 +43,23 @@ def _is_norm_param(path_names: tuple[str, ...]) -> bool:
     return any(k in joined for k in ("batchnorm", "batch_norm", "batch_stats", "/bn", "bn_", "sync_bn", "syncbn"))
 
 
+def _batch_stats_scopes(variables: Any) -> frozenset:
+    """Scope paths that own running statistics — the STRUCTURAL
+    ``isinstance(_BatchNorm)`` signal: every flax BatchNorm/SyncBatchNorm
+    stores (mean, var) in the ``batch_stats`` collection under its own
+    scope, whatever the user named it. Returns () when ``variables`` is
+    a bare params tree (no collections to inspect)."""
+    if not isinstance(variables, dict) or "batch_stats" not in variables:
+        return frozenset()
+    scopes = set()
+    for path, _ in jax.tree_util.tree_flatten_with_path(
+            variables["batch_stats"])[0]:
+        names = tuple(str(getattr(p, "key", getattr(p, "name", p)))
+                      for p in path)
+        scopes.add(names[:-1])   # drop the (mean|var) leaf name
+    return frozenset(scopes)
+
+
 class AmpModel:
     """Forward-pass wrapper produced by :func:`initialize`.
 
@@ -56,6 +73,7 @@ class AmpModel:
                  keep_fp32_predicate: Callable | None = None):
         self.apply_fn = apply_fn
         self.properties = properties
+        self._keep_fp32_is_default = keep_fp32_predicate is None
         self._keep_fp32 = keep_fp32_predicate or (
             (lambda names, x: not _is_norm_param(names))
             if properties.keep_batchnorm_fp32 else None
@@ -67,11 +85,27 @@ class AmpModel:
         O2/O3: floating leaves → half (batchnorm leaves exempt under O2,
         cf. ``convert_network`` ``apex/fp16_utils/fp16util.py:60``).
         O0: → fp32. O1: untouched (weights stay fp32; ops cast).
+
+        BN detection is structural when possible: pass the FULL
+        ``variables`` dict (with its ``batch_stats`` collection) and any
+        scope owning running stats keeps fp32 params, whatever its name
+        — the ``isinstance(_BatchNorm)`` guarantee. The name heuristic
+        remains as a fallback for bare params trees, and an explicit
+        ``keep_fp32_predicate`` overrides both.
         """
         ct = self.properties.cast_model_type
         if ct is None:
             return params
-        return cast_floating(params, ct, self._keep_fp32)
+        keep = self._keep_fp32
+        if (keep is not None and self._keep_fp32_is_default
+                and self.properties.keep_batchnorm_fp32):
+            bn_scopes = _batch_stats_scopes(params)
+            if bn_scopes:
+                def keep(names, x, _scopes=bn_scopes):
+                    # names[0] is the collection ("params"/"batch_stats")
+                    return not (names[1:-1] in _scopes
+                                or _is_norm_param(names))
+        return cast_floating(params, ct, keep)
 
     def __call__(self, params, *args, **kwargs):
         p = self.properties

@@ -81,6 +81,19 @@ class DistributedFusedAdam:
             v_shard=jnp.zeros_like(shard),
         )
 
+    def gather_state(self, state: ShardedAdamState) -> ShardedAdamState:
+        """Topology-independent full state for checkpointing (inside
+        ``shard_map``); see ``apex_tpu.contrib.optimizers.zero_state``."""
+        from apex_tpu.contrib.optimizers.zero_state import gather_zero_state
+        return gather_zero_state(self, state)
+
+    def shard_state(self, full_state: ShardedAdamState,
+                    params=None) -> ShardedAdamState:
+        """Local shard of a gathered state under the CURRENT mesh — the
+        dp=8 -> dp=4 resume path (``distributed_fused_lamb.py:139``)."""
+        from apex_tpu.contrib.optimizers.zero_state import shard_zero_state
+        return shard_zero_state(self, full_state, params)
+
     def apply(self, state: ShardedAdamState, params, grads, skip=None, lr=None):
         """One sharded step; returns (new_params, new_state)."""
         if self._spec is None:

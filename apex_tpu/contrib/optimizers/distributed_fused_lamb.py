@@ -123,6 +123,19 @@ class DistributedFusedLAMB:
         return ShardedLambState(jnp.asarray(0, jnp.int32), shard,
                                 jnp.zeros_like(shard), jnp.zeros_like(shard))
 
+    def gather_state(self, state: ShardedLambState) -> ShardedLambState:
+        """Topology-independent full state for checkpointing (inside
+        ``shard_map``); see ``apex_tpu.contrib.optimizers.zero_state``."""
+        from apex_tpu.contrib.optimizers.zero_state import gather_zero_state
+        return gather_zero_state(self, state)
+
+    def shard_state(self, full_state: ShardedLambState,
+                    params=None) -> ShardedLambState:
+        """Local shard of a gathered state under the CURRENT mesh — the
+        resume path of ``_resume_from_checkpoint`` (lamb.py:139)."""
+        from apex_tpu.contrib.optimizers.zero_state import shard_zero_state
+        return shard_zero_state(self, full_state, params)
+
     def apply(self, state: ShardedLambState, params, grads, skip=None, lr=None):
         if self._spec is None:
             self._prepare(params)

@@ -1,0 +1,72 @@
+"""Checkpoint hooks for ZeRO-sharded optimizer state: gather to a full
+(topology-independent) form for saving, re-shard on load under a
+possibly DIFFERENT world size.
+
+Reference: ``apex/contrib/optimizers/distributed_fused_lamb.py:139``
+``_resume_from_checkpoint`` re-slices a gathered flat buffer into the
+local shard. Here the same two moves are explicit functions usable with
+both ``DistributedFusedAdam`` and ``DistributedFusedLAMB`` (their states
+share the (step, master_shard, m_shard, v_shard) layout):
+
+- ``gather_zero_state`` runs inside ``shard_map`` on the OLD mesh: one
+  ``all_gather`` per buffer, unpadded to the logical parameter count —
+  the result is identical on every rank and is what
+  ``apex_tpu.checkpoint.save_checkpoint`` writes.
+- ``shard_zero_state`` runs inside ``shard_map`` on the NEW mesh: re-pad
+  to the new world size, slice the local shard. dp=8 state resumes on
+  dp=4 (or any world) bit-exactly, because padding is zeros and the
+  sharded update all-gathers identical params regardless of topology.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _pad_to(x, mult):
+    pad = (-x.shape[0]) % mult
+    if pad:
+        x = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
+    return x
+
+
+def gather_zero_state(opt, state):
+    """Full (unsharded) state from a per-rank sharded one; call inside
+    ``shard_map`` over ``opt.axis_name``. ``opt`` must know its flat
+    spec (after ``init``/``apply``)."""
+    if opt._spec is None:
+        raise ValueError("optimizer has no flat spec yet — call init() "
+                         "(or pass the state through apply once) first")
+    world = opt._world()
+
+    def g(x):
+        full = (jax.lax.all_gather(x, opt.axis_name, tiled=True)
+                if world > 1 else x)
+        return full[:opt._spec.total]
+
+    return type(state)(state.step, g(state.master_shard),
+                       g(state.m_shard), g(state.v_shard))
+
+
+def shard_zero_state(opt, full_state, params=None):
+    """Local shard of a full (gathered) state under the CURRENT mesh;
+    call inside ``shard_map`` over ``opt.axis_name``. Pass ``params``
+    when the optimizer is fresh (sets its flat spec)."""
+    if opt._spec is None:
+        if params is None:
+            raise ValueError("fresh optimizer: pass params so the flat "
+                             "spec can be derived")
+        opt.init(params)  # sets the spec; the returned state is discarded
+    world = opt._world()
+
+    def s(x):
+        flat = _pad_to(x, world)
+        per = flat.shape[0] // world
+        if world > 1:
+            rank = jax.lax.axis_index(opt.axis_name)
+            return jax.lax.dynamic_slice_in_dim(flat, rank * per, per)
+        return flat
+
+    return type(full_state)(full_state.step, s(full_state.master_shard),
+                            s(full_state.m_shard), s(full_state.v_shard))

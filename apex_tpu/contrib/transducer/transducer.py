@@ -96,7 +96,12 @@ class TransducerJoint:
         if pack_output:
             # packing exists to skip padded compute on CUDA; on TPU static
             # shapes + masking win — keep the flag but compute unpacked.
-            pass
+            from apex_tpu.utils.parity import warn_inert_once
+            warn_inert_once(
+                "TransducerJoint(pack_output=True) accepted for API "
+                "parity but a no-op on TPU: outputs stay unpacked "
+                "(static shapes + masking beat packed varlen compute "
+                "under XLA)")
         self.relu = relu
         self.dropout = dropout
         self.dropout_prob = dropout_prob

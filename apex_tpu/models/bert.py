@@ -47,10 +47,15 @@ class BertConfig:
     # Megatron-SP (see gpt.py): activations between layers are
     # sequence-sharded over the tensor axis
     sequence_parallel: bool = False
-    # ``loss`` fuses the tied LM-head matmul into the cross entropy
-    # (``ops.lm_head_ce``; no [b, s, V] logits in HBM); False falls back
-    # to attend -> vocab_parallel_cross_entropy (numerics-debug path)
-    fused_lm_head: bool = True
+    # ``loss`` can fuse the tied LM-head matmul into the cross entropy
+    # (``ops.lm_head_ce``; no [b, s, V] logits in HBM). Default False
+    # for BERT by measurement: at BERT-base shape (V=30k, h=768,
+    # 16k tokens) the backward's logit-tile recompute (~3.9 ms of extra
+    # matmul) exceeds what the fusion saves — v5e full-step 128.6 ms
+    # unfused vs 130.4 ms best-tuned fused. Flip it on for large-vocab
+    # variants, where the saved [tokens, V] round trips dominate (GPT at
+    # V=32k/h=1024 measures the other way; see GPTConfig).
+    fused_lm_head: bool = False
 
     @property
     def ffn(self):
@@ -235,6 +240,18 @@ class Bert(nn.Module):
             return jnp.mean(losses)
         w = loss_mask.astype(losses.dtype)
         return jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+    @staticmethod
+    def tensor_parallel_sharded_filter(path_names, leaf=None) -> bool:
+        """True for params that are tp SHARDS (see
+        ``GPT.tensor_parallel_sharded_filter``): qkv/fc1/mlm_dense
+        kernel+bias (Column), proj/fc2 kernel (Row), the vocab-sharded
+        embedding; ln*/wpe/wtte/row-bias leaves are replicated and count
+        once in cross-rank norms. Delegates to the stack's shared
+        classifier (BERT uses the conventional scope names)."""
+        from apex_tpu.transformer.tensor_parallel.layers import (
+            default_tp_sharded_filter)
+        return default_tp_sharded_filter(path_names, leaf)
 
     @staticmethod
     def sequence_parallel_grad_filter(path_names, leaf) -> bool:

@@ -399,6 +399,20 @@ class GPT(nn.Module):
         return jnp.mean(self._ce(variables, out, labels))
 
     @staticmethod
+    def tensor_parallel_sharded_filter(path_names, leaf=None) -> bool:
+        """True for params whose leaf is a tp SHARD of the logical
+        tensor: Column layers (qkv, fc1) kernel+bias, Row layers (proj,
+        fc2) kernel only, and the vocab-sharded embedding. Pass to the
+        per-tensor optimizers (``FusedLAMB(tp_sharded_filter=...)``) so
+        trust-ratio/global norms psum shard partials and count the
+        replicated leaves (ln*, wpe, row biases, MoE router) once.
+        GPT uses the stack's conventional scope names, so this IS the
+        shared default classifier — one source of truth."""
+        from apex_tpu.transformer.tensor_parallel.layers import (
+            default_tp_sharded_filter)
+        return default_tp_sharded_filter(path_names, leaf)
+
+    @staticmethod
     def sequence_parallel_grad_filter(path_names, leaf) -> bool:
         """Selects params whose grads are per-tp-rank partials under
         ``sequence_parallel=True``: layernorm params and the biases added

@@ -57,6 +57,15 @@ from apex_tpu.transformer import parallel_state as ps
 
 _NEG_INF = -1e30
 
+# Mosaic's default scoped-VMEM budget is 16 MB; the backward's resident
+# set at the swept-optimal tiles (bt=256, bv=1024, h=1024) is ~13 MB
+# standalone but is accounted ~19 MB when the kernel sits inside a
+# lax.while/scan body (loop state shares the scope). v5e VMEM is 128 MB;
+# 32 MB leaves the tiles at their measured-fastest sizes in both
+# contexts.
+_VMEM_LIMIT = 32 * 1024 * 1024
+_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+
 
 def _ceil_to(x: int, m: int) -> int:
     return -(-x // m) * m
@@ -72,17 +81,21 @@ def _pick_blocks(n: int, v: int, h: int, block_t: Optional[int],
 
     The backward's resident set is dominated by the fp32 ``dE`` block
     (block_v*h*4) plus the double-buffered bf16 E/x blocks, the fp32
-    logits tile (block_t*block_v*4) and the dx tile. v5e sweep at the
-    GPT bench shape (n=8192, V=32k, h=1024), full-step ms:
-    (bt=256,bv=1024) 97.1 < (256,512) 98.9 < (1024,512) 101.1 ~
-    (512,512) 101.5 < (128,1024) 103.6; (512,1024) and (384,1024)
-    exceed scoped VMEM. A big vocab block halves the dx-partial count
-    (the HBM reduce after the kernel) and keeps the dE accumulator
-    efficient; the small token block is what buys it VMEM headroom."""
+    logits tile (block_t*block_v*4) and the dx tile — ~22 MB at the
+    defaults (bt=512, bv=2048, h=1024), which is why the kernels carry a
+    raised ``vmem_limit_bytes``. v5e sweeps at the GPT bench shape
+    (n=8192, V=32k, h=1024), full-step ms: interleaved A/B gave
+    (512,2048) 102.5 < (256,1024) 105.0 on the same clock; an earlier
+    sweep ranked (256,1024) 97.1 < (256,512) 98.9 < (1024,512) 101.1 ~
+    (512,512) 101.5 < (128,1024) 103.6 across runs (±3 ms thermal
+    drift between runs — only interleaved comparisons rank reliably).
+    A big vocab block halves the dx-partial count (the HBM reduce after
+    the kernel); the token block trades logits-tile VMEM against x
+    re-fetches."""
     if block_t is None:
-        block_t = min(256, _ceil_to(n, 8))
+        block_t = min(512, _ceil_to(n, 8))
     if block_v is None:
-        cap = max(128, (4 * 1024 * 1024) // (4 * h))
+        cap = max(128, (8 * 1024 * 1024) // (4 * h))
         block_v = min(_pow2_at_most(cap), _ceil_to(v, 128))
     return block_t, block_v
 
@@ -125,8 +138,7 @@ def _fwd_kernel(x_ref, e_ref, tgt_ref, m_ref, l_ref, p_ref, *out_refs,
 
 def _bwd_kernel(x_ref, e_ref, tgt_ref, m_ref, l_ref, dl_ref,
                 de_ref, dxp_ref, *, block_v: int, v_local: int,
-                v_total: int, label_smoothing: float, n_tb: int,
-                upcast: bool):
+                v_total: int, label_smoothing: float, upcast: bool):
     """Recompute one logit tile, form the (softmax - target) gradient in
     VMEM, contract into dE (accumulated over the inner token-block grid
     dim) and a per-vocab-block dx partial."""
@@ -190,6 +202,7 @@ def _fwd_partials(x, e, tgt_local, block_t, block_v, v_local, interpret,
             pl.BlockSpec((1, 1, block_t), lambda v, t: (v, 0, t))] * n_out,
         out_shape=[jax.ShapeDtypeStruct((n_vb, 1, n), jnp.float32)] * n_out,
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(x, e, tgt_local)
     m, l, pred = (a[:, 0] for a in outs[:3])
     # combine the per-vocab-block online-softmax partials (tiny: [n_vb, n])
@@ -240,7 +253,7 @@ def _fused_ce_bwd(label_smoothing, axis_name, block_t, block_v, v_local,
     n_vb = pl.cdiv(v_local, block_v)
     kern = functools.partial(
         _bwd_kernel, block_v=block_v, v_local=v_local, v_total=v_total,
-        label_smoothing=label_smoothing, n_tb=n_tb, upcast=interpret)
+        label_smoothing=label_smoothing, upcast=interpret)
     de, dxp = pl.pallas_call(
         kern,
         grid=(n_vb, n_tb),
@@ -261,6 +274,7 @@ def _fused_ce_bwd(label_smoothing, axis_name, block_t, block_v, v_local,
             jax.ShapeDtypeStruct((n_vb, n, h), x.dtype),
         ],
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(x, ec, tgt, m_g[None], l_g[None],
       dloss.astype(jnp.float32)[None])
     # e arrives padded to a block multiple (see wrapper); the pad's own

@@ -100,6 +100,46 @@ class FusedOptimizerBase:
     def _all_params(self):
         return [g["params"] for g in self.param_groups]
 
+    # -- tensor-parallel norm plumbing --------------------------------------
+    # Per-tensor optimizers (LAMB, NovoGrad) and global-norm clipping
+    # reduce over WHOLE logical tensors; under tensor parallelism a
+    # Column/Row/VocabParallel leaf is a shard, so those reductions must
+    # psum squared partials over the tp axis — and replicated leaves must
+    # be counted ONCE, not tp times (the reference's
+    # ``param_is_not_tensor_parallel_duplicate`` dedup,
+    # ``apex/transformer/tensor_parallel/layers.py:47-57``). Configure
+    # with ``tp_axis_name`` + ``tp_sharded_filter(path_names, leaf)``
+    # (models provide one, e.g. ``GPT.tensor_parallel_sharded_filter``).
+    tp_axis_name: str | None = None
+    tp_sharded_filter = None
+
+    def _tp_mask(self, tree):
+        """Pytree of python bools: which leaves are tp-SHARDED. None when
+        tp awareness is off."""
+        if self.tp_axis_name is None or self.tp_sharded_filter is None:
+            return None
+        from apex_tpu.utils.tree import tree_map_with_path_names
+        return tree_map_with_path_names(
+            lambda names, x: bool(self.tp_sharded_filter(names, x)), tree)
+
+    def _tp_psum(self, x):
+        try:
+            return jax.lax.psum(x, self.tp_axis_name)
+        except NameError:   # outside shard_map (tp=1 use): identity
+            return x
+
+    def _tp_pmax(self, x):
+        try:
+            return jax.lax.pmax(x, self.tp_axis_name)
+        except NameError:
+            return x
+
+    def _tp_rank_is_zero(self):
+        try:
+            return jax.lax.axis_index(self.tp_axis_name) == 0
+        except NameError:
+            return jnp.asarray(True)
+
     # -- to be provided by subclasses --------------------------------------
     def _init_slots(self, p32, group: dict) -> Any:
         """``p32`` is the fp32 master pytree; return moment pytrees."""

@@ -28,7 +28,14 @@ class FusedLAMB(FusedOptimizerBase):
                  betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
                  amsgrad=False, adam_w_mode=True, grad_averaging=True,
                  set_grad_none=False, max_grad_norm=1.0, use_nvlamb=False,
-                 *, master_weights=False):
+                 *, master_weights=False, tp_axis_name=None,
+                 tp_sharded_filter=None):
+        """``tp_axis_name``/``tp_sharded_filter``: run inside ``shard_map``
+        under tensor parallelism — per-tensor trust-ratio norms and the
+        global grad norm then psum squared partials of SHARDED leaves
+        over the tp axis and count replicated leaves once (see
+        ``FusedOptimizerBase`` tp plumbing). Without them, a tp>1 model
+        would get a different trust ratio per rank from partial norms."""
         if amsgrad:
             raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
         defaults = dict(lr=lr, bias_correction=bias_correction, betas=betas,
@@ -37,6 +44,15 @@ class FusedLAMB(FusedOptimizerBase):
                         max_grad_norm=max_grad_norm)
         self.adam_w_mode = adam_w_mode
         self.use_nvlamb = use_nvlamb
+        self.tp_axis_name = tp_axis_name
+        if tp_axis_name is not None and tp_sharded_filter is None:
+            # an unset filter must not silently treat every leaf as
+            # sharded (replicated leaves would be psum'd world-times into
+            # the norms) — default to the stack's layer-name conventions
+            from apex_tpu.transformer.tensor_parallel.layers import (
+                default_tp_sharded_filter)
+            tp_sharded_filter = default_tp_sharded_filter
+        self.tp_sharded_filter = tp_sharded_filter
         super().__init__(params, defaults, master_weights=master_weights)
 
     def _init_slots(self, p32, group):
@@ -45,14 +61,27 @@ class FusedLAMB(FusedOptimizerBase):
 
     def apply(self, state, params, grads, skip=None, **overrides):
         # Phase 1 (fused_lamb.py:116-143): global grad norm across ALL
-        # groups, computed before any per-group update.
+        # groups, computed before any per-group update. Under tp, sharded
+        # leaves contribute their partial everywhere (summed by the
+        # psum) while replicated leaves count only on rank 0 — the
+        # param_is_not_tensor_parallel_duplicate dedup.
         single = len(self.param_groups) == 1
         glist = [grads] if single else list(grads)
         sq = jnp.asarray(0.0, jnp.float32)
+        tp = self.tp_axis_name is not None
+        rank0 = self._tp_rank_is_zero() if tp else None
         for g in glist:
-            for leaf in jax.tree.leaves(g):
+            mask = self._tp_mask(g)
+            mleaves = (jax.tree.leaves(mask) if mask is not None
+                       else [True] * len(jax.tree.leaves(g)))
+            for leaf, sharded in zip(jax.tree.leaves(g), mleaves):
                 leaf = leaf.astype(jnp.float32)
-                sq = sq + jnp.sum(leaf * leaf)
+                s = jnp.sum(leaf * leaf)
+                if tp and not sharded:
+                    s = jnp.where(rank0, s, 0.0)
+                sq = sq + s
+        if tp:
+            sq = self._tp_psum(sq)
         self._global_grad_norm = jnp.sqrt(sq)
         return super().apply(state, params, grads, skip=skip, **overrides)
 
@@ -89,8 +118,10 @@ class FusedLAMB(FusedOptimizerBase):
         # use_nvlamb=False (fused_lamb.py use_nvlamb flag; here wd is
         # per-group so the per-tensor condition reduces to the norms check).
         use_ratio = self.use_nvlamb or wd != 0.0
+        tp = self.tp_axis_name is not None
+        mask = self._tp_mask(p)
 
-        def leaf(p, m, v):
+        def leaf(p, m, v, sharded=True):
             update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
             if wd != 0.0:
                 update = update + wd * p
@@ -101,15 +132,25 @@ class FusedLAMB(FusedOptimizerBase):
             # slot re-reads)
             update = jax.lax.optimization_barrier(update)
             if use_ratio:
-                # per-tensor trust ratio ||w|| / ||update|| — each leaf's
-                # own reduction (multi_tensor_lamb.cu phase 2)
-                w_n = jnp.sqrt(jnp.sum(p * p))
-                u_n = jnp.sqrt(jnp.sum(update * update))
+                # per-tensor trust ratio ||w|| / ||update|| — the norms
+                # are over the LOGICAL tensor: a tp-sharded leaf psums
+                # its squared partials (replicated leaves are already
+                # whole-tensor local)
+                w_sq = jnp.sum(p * p)
+                u_sq = jnp.sum(update * update)
+                if tp and sharded:
+                    w_sq = self._tp_psum(w_sq)
+                    u_sq = self._tp_psum(u_sq)
+                w_n = jnp.sqrt(w_sq)
+                u_n = jnp.sqrt(u_sq)
                 ratio = jnp.where((w_n > 0) & (u_n > 0),
                                   w_n / jnp.maximum(u_n, 1e-30), 1.0)
             else:
                 ratio = jnp.asarray(1.0, jnp.float32)
             return p - lr * ratio * update
 
-        new_p = jax.tree.map(leaf, p, m, v)
+        if mask is None:
+            new_p = jax.tree.map(leaf, p, m, v)
+        else:
+            new_p = jax.tree.map(leaf, p, m, v, mask)
         return new_p, {"exp_avg": m, "exp_avg_sq": v}

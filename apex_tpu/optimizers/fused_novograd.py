@@ -25,7 +25,12 @@ class FusedNovoGrad(FusedOptimizerBase):
                  betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
                  amsgrad=False, reg_inside_moment=False, grad_averaging=True,
                  norm_type=2, init_zero=False, set_grad_none=False,
-                 *, master_weights=False):
+                 *, master_weights=False, tp_axis_name=None,
+                 tp_sharded_filter=None):
+        """``tp_axis_name``/``tp_sharded_filter``: see ``FusedLAMB`` — the
+        per-tensor grad norm feeding the scalar second moment must span
+        the LOGICAL tensor, so sharded leaves psum (L2) / pmax (inf)
+        their partials over the tp axis."""
         if amsgrad:
             raise RuntimeError("FusedNovoGrad does not support the AMSGrad variant.")
         if norm_type not in (0, 2):
@@ -36,6 +41,13 @@ class FusedNovoGrad(FusedOptimizerBase):
         self.moment_mode = 0 if reg_inside_moment else 1
         self.norm_type = norm_type
         self.init_zero = init_zero
+        self.tp_axis_name = tp_axis_name
+        if tp_axis_name is not None and tp_sharded_filter is None:
+            # see FusedLAMB: never default to "everything is sharded"
+            from apex_tpu.transformer.tensor_parallel.layers import (
+                default_tp_sharded_filter)
+            tp_sharded_filter = default_tp_sharded_filter
+        self.tp_sharded_filter = tp_sharded_filter
         super().__init__(params, defaults, master_weights=master_weights)
 
     def _init_slots(self, p32, group):
@@ -60,15 +72,27 @@ class FusedNovoGrad(FusedOptimizerBase):
         grad_averaging = group.get("grad_averaging", True)
         inited = slots["initialized"]
 
-        def v_leaf(v, g):
-            g_norm = self._tensor_norm(g)
-            gn2 = g_norm * g_norm if self.norm_type == 2 else g_norm
+        tp = self.tp_axis_name is not None
+        mask = self._tp_mask(g)
+
+        def v_leaf(v, g, sharded=True):
+            if self.norm_type == 2:
+                gn2 = jnp.sum(g * g)
+                if tp and sharded:
+                    gn2 = self._tp_psum(gn2)   # logical-tensor L2^2
+            else:
+                gn2 = jnp.max(jnp.abs(g))
+                if tp and sharded:
+                    gn2 = self._tp_pmax(gn2)   # logical-tensor inf norm
             # init_zero=False: first step seeds v with ||g||^2
             # (fused_novograd.py:151-158)
             v_seed = jnp.zeros_like(gn2) if self.init_zero else gn2
             return jnp.where(inited, beta2 * v + (1.0 - beta2) * gn2, v_seed)
 
-        v_next = jax.tree.map(v_leaf, slots["exp_avg_sq"], g)
+        if mask is None:
+            v_next = jax.tree.map(v_leaf, slots["exp_avg_sq"], g)
+        else:
+            v_next = jax.tree.map(v_leaf, slots["exp_avg_sq"], g, mask)
 
         beta1_eff = (1.0 - beta1) if grad_averaging else 1.0
 

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.utils.flat import flatten_tensors, unflatten_tensors
+from apex_tpu.utils.parity import warn_inert_once as _warn_inert_once
 
 
 def allreduce_gradients(
@@ -99,8 +100,25 @@ class DistributedDataParallel:
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
-        # message_size / streams / communicators are accepted for API parity;
-        # XLA owns fusion & overlap of the collective on TPU.
+        # message_size / streams / communicators are accepted for API
+        # parity; XLA owns fusion & overlap of the collective on TPU.
+        # Ported code deserves a one-time heads-up when it sets them to
+        # non-defaults expecting CUDA-stream behavior.
+        inert = []
+        if message_size != 10_000_000:
+            inert.append(f"message_size={message_size}")
+        if num_allreduce_streams != 1:
+            inert.append(f"num_allreduce_streams={num_allreduce_streams}")
+        if allreduce_communicators is not None:
+            inert.append("allreduce_communicators")
+        if inert:
+            _warn_inert_once(
+                "DistributedDataParallel: "
+                + ", ".join(inert)
+                + " accepted for API parity but a no-op on TPU (XLA "
+                "fuses, buckets and overlaps the gradient all-reduce "
+                "itself; there are no CUDA streams or NCCL "
+                "communicators to configure)")
 
     def __call__(self, params, *args, **kwargs):
         return self.module(params, *args, **kwargs)

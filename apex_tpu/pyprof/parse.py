@@ -109,12 +109,27 @@ def op_stats_from_raw(raw, host: bool = False, include_idle: bool = False,
         if t and placements(t) == {want}:
             sel = list(t)
             break
+    def filter_all_tables(placement):
+        # fall back across ALL tables (not just the first: converter
+        # versions differ in emission order — advisor r3). Dedup is
+        # CROSS-table only — the combined and device-only tables repeat
+        # the same ops — while same-named rows within one table (e.g.
+        # the same fusion in two compiled programs) are all kept.
+        seen, rows = set(), []
+        for t in tables:
+            table_keys = set()
+            for r in t:
+                key = (r.get("operation"), r.get("host_or_device"))
+                if r.get("host_or_device") == placement and key not in seen:
+                    table_keys.add(key)
+                    rows.append(r)
+            seen |= table_keys
+        return rows
+
     if sel is None:
-        sel = [r for t in tables[:1] for r in t
-               if r.get("host_or_device") == want]
+        sel = filter_all_tables(want)
     if not sel and not host:
-        sel = [r for t in tables[:1] for r in t
-               if r.get("host_or_device") == "Host"]
+        sel = filter_all_tables("Host")
     if not include_idle:
         sel = [r for r in sel if r.get("type") != "IDLE"]
     out = []

@@ -42,11 +42,37 @@ def set_tensor_model_parallel_attributes(param, is_parallel: bool, dim: int, str
     return param
 
 
-def param_is_not_tensor_parallel_duplicate(path_names: tuple[str, ...]) -> bool:
-    """True if a param is either TP-partitioned or owned by tp rank 0 —
-    used to avoid double-counting in norms (``layers.py:47-57`` analog,
-    decided by name here)."""
-    return True  # sharded modules only hold non-duplicate shards
+def default_tp_sharded_filter(path_names: tuple[str, ...], leaf=None) -> bool:
+    """Heuristic tp-SHARDED classifier for trees built from this stack's
+    layers under their conventional scope names: Column layers (qkv, fc1,
+    mlm_dense, lm_head) shard kernel AND bias, Row layers (proj, fc2)
+    shard the kernel only, VocabParallelEmbedding shards the table.
+    Models with exact knowledge should provide their own filter (e.g.
+    ``GPT.tensor_parallel_sharded_filter``); this is the fallback the
+    optimizers' ``tp_sharded_filter`` option can use for quick ports."""
+    del leaf
+    names = [str(n).lower() for n in path_names]
+    column = any(n in ("qkv", "fc1", "mlm_dense", "lm_head") for n in names)
+    row = any(n in ("proj", "fc2") for n in names)
+    if column:
+        return True                       # kernel + bias both sharded
+    if row:
+        return "kernel" in names          # row bias is replicated
+    return "wte" in names and "embedding" in names
+
+
+def param_is_not_tensor_parallel_duplicate(path_names: tuple[str, ...],
+                                           leaf=None,
+                                           sharded_filter=None):
+    """True when a param must be counted in cross-rank norm reductions:
+    it is tp-partitioned (every rank owns a distinct shard), or it is
+    replicated and this is tp rank 0 (``layers.py:47-57``). Inside
+    ``shard_map`` the rank-0 term is a traced bool; outside (tp=1) it is
+    statically True."""
+    if (sharded_filter or default_tp_sharded_filter)(path_names, leaf):
+        return True
+    # python bool outside shard_map (rank is the int 0), traced inside
+    return ps.get_tensor_model_parallel_rank() == 0
 
 
 def _tp_rank_static():

@@ -7,3 +7,4 @@ from apex_tpu.utils.tree import (  # noqa: F401
     is_floating,
 )
 from apex_tpu.utils.flat import FlatBuffer, flatten_tensors, unflatten_tensors  # noqa: F401
+from apex_tpu.utils.parity import warn_inert_once  # noqa: F401

@@ -1,0 +1,24 @@
+"""One-time notices for reference-API knobs that are inert on TPU.
+
+The reference exposes CUDA-runtime tuning options (NCCL stream counts,
+bucket byte sizes, packed-output modes) that have no TPU analog — XLA
+owns collective fusion/overlap and static-shape compute. apex_tpu keeps
+the option surfaces for drop-in parity (``apex/parallel/distributed.py:
+129-170``) but ported code that sets them to non-defaults deserves one
+loud heads-up instead of silent acceptance."""
+
+from __future__ import annotations
+
+import warnings
+
+_seen: set = set()
+
+
+def warn_inert_once(msg: str, key: str | None = None) -> None:
+    """Emit ``msg`` as a UserWarning once per ``key`` (default: the
+    message itself) for the life of the process."""
+    k = key or msg
+    if k in _seen:
+        return
+    _seen.add(k)
+    warnings.warn(msg, UserWarning, stacklevel=3)

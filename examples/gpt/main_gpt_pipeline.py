@@ -106,6 +106,16 @@ def main():
         labels = np.roll(ids, -1, axis=2)
         ids, labels = jnp.asarray(ids), jnp.asarray(labels)
         if state is None:
+            # NB the P() out_specs are a device-loop-only contract: the
+            # "chunks" params (and their optimizer state) actually differ
+            # per pipeline rank (and TP shards per tensor rank), which
+            # check_vma=False lets through. The state is only ever fed
+            # back into shard_maps with these same specs, so on-device it
+            # stays consistent — but materializing it on host (print,
+            # checkpoint) would silently read ONE rank's chunk params.
+            # For host-side state use P(ps.PIPELINE_AXIS) on the chunks
+            # subtree as tests/test_transformer.py's pipeline parity test
+            # does, or save via apex_tpu.checkpoint which gathers shards.
             init_f = jax.jit(shard_map(
                 init_state, mesh=mesh, in_specs=(P(None, ps.DATA_AXIS),),
                 out_specs=(P(), P(), P()), check_vma=False))

@@ -203,3 +203,14 @@ def test_contrib_fast_layer_norm_parity_surface():
     y2 = ln_fwd(x, jnp.ones((32,)), jnp.zeros((32,)))
     np.testing.assert_allclose(np.asarray(y2), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_transducer_pack_output_warns_inert():
+    import pytest
+    from apex_tpu.contrib.transducer.transducer import TransducerJoint
+    """pack_output is a CUDA packed-varlen knob; on TPU it is accepted
+    for parity and warns once."""
+    from apex_tpu.utils import parity
+    parity._seen.clear()
+    with pytest.warns(UserWarning, match="pack_output"):
+        TransducerJoint(pack_output=True)

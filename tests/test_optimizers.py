@@ -285,3 +285,102 @@ def test_master_weights_never_alias_params():
     p4, s4 = step(p3, s3, g)
     p5, s5 = step(p4, s4, g)
     assert np.isfinite(np.asarray(p5["w"])).all()
+
+
+@pytest.mark.parametrize("max_grad_norm", [1.0, 0.05])
+def test_lamb_tp2_matches_tp1(max_grad_norm):
+    """LAMB under tensor parallelism: per-tensor trust-ratio norms and
+    the clip's global grad norm must span the LOGICAL tensors — sharded
+    leaves psum partials, replicated leaves count once (verdict r3
+    weakness 1; reference: fused_lamb.py:124-133 norms +
+    tensor_parallel/layers.py:47-57 dedup). tp=2 shard updates must
+    equal slices of the tp=1 update, including when clipping engages."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+    from apex_tpu.optimizers import FusedLAMB
+
+    rng = np.random.RandomState(0)
+    full = {"col": jnp.asarray(rng.randn(6, 8), jnp.float32),   # sharded dim1
+            "ln": jnp.asarray(rng.randn(8), jnp.float32)}        # replicated
+    grads = [{"col": jnp.asarray(rng.randn(6, 8) * s, jnp.float32),
+              "ln": jnp.asarray(rng.randn(8) * s, jnp.float32)}
+             for s in (1.0, 0.5, 2.0)]
+
+    def run_tp1():
+        opt = FusedLAMB(lr=1e-2, max_grad_norm=max_grad_norm)
+        p, st = full, opt.init(full)
+        for g in grads:
+            p, st = opt.apply(st, p, g)
+        return p
+
+    def run_tp2():
+        mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("tensor",))
+        opt = FusedLAMB(
+            lr=1e-2, max_grad_norm=max_grad_norm, tp_axis_name="tensor",
+            tp_sharded_filter=lambda names, x: "col" in names)
+
+        def inner(full, *gs):
+            rank = jax.lax.axis_index("tensor")
+            shard = lambda t: {"col": jax.lax.dynamic_slice_in_dim(
+                t["col"], rank * 4, 4, axis=1), "ln": t["ln"]}
+            p = shard(full)
+            st = opt.init(p)
+            for g in gs:
+                p, st = opt.apply(st, p, shard(g))
+            # gather the col shards back for comparison
+            col = jax.lax.all_gather(p["col"], "tensor", axis=1, tiled=True)
+            return {"col": col, "ln": p["ln"]}
+
+        return shard_map(inner, mesh=mesh,
+                         in_specs=tuple(P() for _ in range(4)),
+                         out_specs=P(), check_vma=False)(full, *grads)
+
+    p1 = run_tp1()
+    p2 = run_tp2()
+    np.testing.assert_allclose(np.asarray(p2["col"]), np.asarray(p1["col"]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(p2["ln"]), np.asarray(p1["ln"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_novograd_tp2_matches_tp1():
+    """NovoGrad's per-tensor scalar second moment is the logical-tensor
+    grad norm under tp (L2 psum of shard partials)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+    from apex_tpu.optimizers import FusedNovoGrad
+
+    rng = np.random.RandomState(1)
+    full = {"col": jnp.asarray(rng.randn(4, 8), jnp.float32),
+            "ln": jnp.asarray(rng.randn(6), jnp.float32)}
+    grads = [{"col": jnp.asarray(rng.randn(4, 8) * s, jnp.float32),
+              "ln": jnp.asarray(rng.randn(6) * s, jnp.float32)}
+             for s in (1.0, 0.3)]
+
+    opt1 = FusedNovoGrad(lr=1e-2, weight_decay=0.01)
+    p, st = full, opt1.init(full)
+    for g in grads:
+        p, st = opt1.apply(st, p, g)
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("tensor",))
+    opt2 = FusedNovoGrad(
+        lr=1e-2, weight_decay=0.01, tp_axis_name="tensor",
+        tp_sharded_filter=lambda names, x: "col" in names)
+
+    def inner(full, *gs):
+        rank = jax.lax.axis_index("tensor")
+        shard = lambda t: {"col": jax.lax.dynamic_slice_in_dim(
+            t["col"], rank * 4, 4, axis=1), "ln": t["ln"]}
+        pp = shard(full)
+        st = opt2.init(pp)
+        for g in gs:
+            pp, st = opt2.apply(st, pp, shard(g))
+        return {"col": jax.lax.all_gather(pp["col"], "tensor", axis=1,
+                                          tiled=True), "ln": pp["ln"]}
+
+    p2 = shard_map(inner, mesh=mesh, in_specs=tuple(P() for _ in range(3)),
+                   out_specs=P(), check_vma=False)(full, *grads)
+    np.testing.assert_allclose(np.asarray(p2["col"]), np.asarray(p["col"]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(p2["ln"]), np.asarray(p["ln"]),
+                               rtol=1e-5, atol=1e-6)

@@ -164,3 +164,19 @@ def test_flat_dist_call():
     oa, ob = f(a, b)
     np.testing.assert_allclose(np.asarray(oa), n * 1.0)
     np.testing.assert_allclose(np.asarray(ob), n * 2.0)
+
+
+def test_ddp_inert_knob_warning():
+    """CUDA-runtime tuning knobs are accepted for parity but warn once
+    (apex/parallel/distributed.py:129-170 option surface)."""
+    import warnings as _w
+    from apex_tpu.utils import parity
+    parity._seen.clear()
+    with pytest.warns(UserWarning, match="no-op on TPU"):
+        DistributedDataParallel(lambda p, x: x, num_allreduce_streams=4,
+                                message_size=1 << 20)
+    # defaults stay silent
+    parity._seen.clear()
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        DistributedDataParallel(lambda p, x: x)

@@ -821,3 +821,58 @@ def test_pipelined_gpt_moe_matches_sequential(sp):
                 np.asarray(b), np.asarray(a), rtol=3e-4, atol=3e-5,
                 err_msg=f"stage{g_stage}{pa}")
     ps.destroy_model_parallel()
+
+
+def test_bert_lamb_tp4_matches_tp1(tp_mesh):
+    """The verdict-r3 certification: BERT + FusedLAMB trained at tp=4
+    (with tp-aware trust-ratio/global norms) follows the tp=1 loss and
+    parameter trajectory over 3 steps. Without the tp norm reductions
+    each rank would apply a different trust ratio from partial norms."""
+    from apex_tpu.models.bert import Bert, BertConfig
+    from apex_tpu.optimizers import FusedLAMB
+
+    kw = dict(vocab_size=64, max_seq_len=16, hidden_size=32, num_layers=2,
+              num_heads=4, dtype=jnp.float32, use_flash=False,
+              type_vocab_size=0)
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, 64, (2, 8)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, 64, (2, 8)), jnp.int32)
+
+    def train(model, opt, v):
+        st = opt.init(v)
+        losses = []
+        for _ in range(3):
+            loss, g = jax.value_and_grad(
+                lambda v: model.loss(v, ids, labels))(v)
+            v, st = opt.apply(st, v, g)
+            losses.append(loss)
+        return jnp.stack(losses), v
+
+    # tp=4 inside shard_map (the fixture's mesh), tp-aware LAMB
+    model = Bert(BertConfig(**kw))
+    opt_tp = FusedLAMB(
+        lr=1e-2, tp_axis_name=ps.TENSOR_AXIS,
+        tp_sharded_filter=Bert.tensor_parallel_sharded_filter)
+
+    def inner(ids_, labels_):
+        v = model.init(jax.random.PRNGKey(0), ids_)
+        losses, v2 = train(model, opt_tp, v)
+        # one replicated leaf comes out for parity checking
+        return losses, v2["params"]["ln_emb"]["weight"]
+
+    losses_tp, ln_tp = shard_map(
+        inner, mesh=tp_mesh, in_specs=(P(), P()), out_specs=(P(), P()),
+        check_vma=False)(ids, labels)
+
+    # tp=1 reference
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(tensor_model_parallel_size_=1)
+    model1 = Bert(BertConfig(**kw))
+    v1 = model1.init(jax.random.PRNGKey(0), ids)
+    losses_1, v1f = train(model1, FusedLAMB(lr=1e-2), v1)
+
+    np.testing.assert_allclose(np.asarray(losses_tp), np.asarray(losses_1),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(ln_tp), np.asarray(v1f["params"]["ln_emb"]["weight"]),
+        rtol=2e-4, atol=2e-5)
