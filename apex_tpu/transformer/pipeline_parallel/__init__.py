@@ -20,5 +20,6 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
     forward_backward_no_pipelining,
     forward_backward_pipelining_without_interleaving,
     forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_1f1b,
     get_forward_backward_func,
 )

@@ -6,8 +6,10 @@ bookkeeping. The TPU-native formulation: every stage runs the SAME scanned
 program (SPMD), activations move with one ``ppermute`` per tick, microbatch
 injection/collection are masked by stage index, and the backward schedule
 falls out of ``jax.grad`` of the scan — XLA reverses the pipeline
-automatically, with ``jax.checkpoint`` on the stage function standing in
-for 1F1B's memory discipline.
+automatically. Reverse-mode through the scan stashes one stage-input
+residual per tick (GPipe's memory profile, linear in microbatch count);
+``forward_backward_pipelining_1f1b`` below restores 1F1B's O(P·mb)
+bound with explicit in-scan VJP (measured table: docs/perf.md).
 
 ``pipeline_apply(stage_fn, stage_params, x, n_microbatches)`` must run
 inside ``shard_map`` over the ``pipeline`` mesh axis, with
@@ -26,7 +28,7 @@ import jax.numpy as jnp
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.microbatches import resolve_num_microbatches
 from apex_tpu.transformer.pipeline_parallel.p2p import (
-    ring_shift, send_forward_recv_forward)
+    ring_shift, send_backward_recv_backward, send_forward_recv_forward)
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x,
@@ -111,6 +113,107 @@ def forward_backward_pipelining_without_interleaving(
 
     loss, grads = jax.value_and_grad(full)(stage_params)
     return loss, grads
+
+
+def forward_backward_pipelining_1f1b(
+        stage_fn: Callable, loss_mb: Callable, stage_params, x,
+        n_microbatches: int, axis_name: str = ps.PIPELINE_AXIS):
+    """1F1B pipeline: bounded activation memory, O(P·mb) not O(nmb·mb).
+
+    The fill-drain schedule above differentiates *through* the scan, so
+    reverse-mode stashes one stage-input residual per tick — peak
+    activation memory grows linearly with ``n_microbatches`` (measured:
+    `tests/test_transformer.py::test_pipeline_memory_discipline`). This
+    schedule is the TPU-native restatement of Megatron 1F1B (the memory
+    rationale behind ``apex/transformer/parallel_state.py:252-322``):
+    forward and backward units run in the SAME scan, gradients accumulate
+    in the carry, and the only cross-tick activation state is a circular
+    stash of ``2P-1`` stage inputs per rank — constant in
+    ``n_microbatches``.
+
+    Tick ``i`` runs (SPMD, all ranks the same program):
+
+    - forward unit ``m_f = i - rank`` (the fill-drain timeline): consume
+      the held activation (or inject ``x[m_f]`` on rank 0), apply
+      ``stage_fn``, stash the INPUT, ``ppermute`` the output forward.
+    - backward unit ``m_b = i - 2(P-1) + rank`` (the time-reversed
+      timeline, delayed so the last rank's backward of microbatch ``m``
+      immediately follows its forward): pop the stashed input, replay
+      ``stage_fn`` under ``jax.vjp`` (rematerialization — nothing but
+      the input survives from the forward pass), seed the cotangent from
+      ``loss_mb`` on the last rank or from the next stage's ``ppermute``
+      otherwise, accumulate the parameter cotangent, send the input
+      cotangent backward.
+
+    The cotangent rank r emits at tick ``i`` is consumed by rank r-1 at
+    tick ``i+1`` for the SAME microbatch (both sides compute
+    ``m = i - 2(P-1) + r``), so one reverse ``ppermute`` per tick is the
+    whole backward transport. Total ticks ``nmb + 2(P-1)`` vs fill-drain's
+    ``2(nmb + P - 1)`` forward+backward ticks — same bubble fraction,
+    same 2-forwards+1-backward compute per microbatch as remat fill-drain.
+
+    ``loss_mb(out) -> scalar`` applies per microbatch on the last stage;
+    the returned loss is the SUM over microbatches (divide inside
+    ``loss_mb`` by ``n_microbatches`` for a mean). Returns
+    ``(loss, grads)`` with the loss masked to the last rank — ``psum``
+    both over the pipeline axis, exactly as with the fill-drain variant.
+    """
+    n_microbatches = resolve_num_microbatches(n_microbatches)
+    n_stages = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    is_last = rank == n_stages - 1
+    delay = 2 * (n_stages - 1)
+    total_ticks = n_microbatches + delay
+    stash_slots = max(1, 2 * n_stages - 1)
+
+    h_shape = x.shape[1:]
+    init = (
+        jnp.zeros(h_shape, x.dtype),                      # held_f
+        jnp.zeros(h_shape, x.dtype),                      # held_b (cotangent)
+        jnp.zeros((stash_slots,) + h_shape, x.dtype),     # input stash
+        jax.tree.map(jnp.zeros_like, stage_params),       # grad accumulator
+        jnp.zeros((), jnp.float32),                       # loss sum
+    )
+
+    def tick(carry, i):
+        held_f, held_b, stash, grads, loss_sum = carry
+
+        # -- forward unit ------------------------------------------------
+        m_f = i - rank
+        valid_f = (m_f >= 0) & (m_f < n_microbatches)
+        m_fc = jnp.clip(m_f, 0, n_microbatches - 1)
+        inject = jax.lax.dynamic_index_in_dim(x, m_fc, keepdims=False)
+        inp = jnp.where(valid_f & (rank == 0), inject, held_f)
+        out = stage_fn(stage_params, inp)
+        # stash the stage input; on invalid ticks rewrite the slot's own
+        # value (read-modify-write keeps the update in place — a
+        # where() over the whole stash would copy all slots every tick)
+        slot = m_fc % stash_slots
+        cur = jax.lax.dynamic_index_in_dim(stash, slot, keepdims=False)
+        stash = jax.lax.dynamic_update_index_in_dim(
+            stash, jnp.where(valid_f, inp, cur), slot, 0)
+        held_f = send_forward_recv_forward(out, axis_name)
+
+        # -- backward unit ----------------------------------------------
+        m_b = i - delay + rank
+        valid_b = (m_b >= 0) & (m_b < n_microbatches)
+        m_bc = jnp.clip(m_b, 0, n_microbatches - 1)
+        inp_b = jax.lax.dynamic_index_in_dim(
+            stash, m_bc % stash_slots, keepdims=False)
+        out_b, pullback = jax.vjp(stage_fn, stage_params, inp_b)
+        loss_val, seed = jax.value_and_grad(loss_mb)(out_b)
+        g_out = jnp.where(is_last, seed.astype(out_b.dtype), held_b)
+        dparams, dinp = pullback(g_out)
+        grads = jax.tree.map(
+            lambda a, d: a + jnp.where(valid_b, d, 0), grads, dparams)
+        loss_sum = loss_sum + jnp.where(valid_b & is_last, loss_val, 0.0)
+        held_b = send_backward_recv_backward(dinp, axis_name)
+
+        return (held_f, held_b, stash, grads, loss_sum), None
+
+    (_, _, _, grads, loss_sum), _ = jax.lax.scan(
+        tick, init, jnp.arange(total_ticks))
+    return loss_sum, grads
 
 
 def pipeline_apply_interleaved(stage_fn: Callable, chunk_params, x,
@@ -205,8 +308,21 @@ def pipeline_apply_interleaved(stage_fn: Callable, chunk_params, x,
 def forward_backward_pipelining_with_interleaving(
         stage_fn: Callable, loss_head: Callable, chunk_params, x,
         n_microbatches: int, n_chunks: Optional[int] = None,
-        axis_name: str = ps.PIPELINE_AXIS):
-    """Interleaved pipeline + loss, returning (loss, chunk-param grads)."""
+        axis_name: str = ps.PIPELINE_AXIS,
+        microbatch_group_size: Optional[int] = None):
+    """Interleaved pipeline + loss, returning (loss, chunk-param grads).
+
+    ``microbatch_group_size`` (staged grads): differentiating through the
+    full schedule stashes one stage-input residual per tick, so peak
+    activation memory grows with ``n_microbatches``. Setting a group size
+    ``G`` (a multiple of the pipeline size that divides
+    ``n_microbatches``) runs the schedule on G microbatches at a time in
+    an outer non-differentiated scan, accumulating gradients in the
+    carry — peak activation memory becomes O(G·mb) at the cost of one
+    extra (P-1)-tick bubble per group. The returned loss is the SUM of
+    per-group ``loss_head`` values: a ``loss_head`` that means over its
+    microbatch axis needs an external ``/ (n_microbatches // G)``.
+    """
     n_microbatches = resolve_num_microbatches(n_microbatches)
     n_stages = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
@@ -216,14 +332,30 @@ def forward_backward_pipelining_with_interleaving(
             leaf = jax.tree_util.tree_leaves(chunk_params)[0]
             n_chunks = leaf.shape[0]
 
-    def full(params):
-        outs = pipeline_apply_interleaved(stage_fn, params, x,
-                                          n_microbatches, n_chunks,
-                                          axis_name)
+    def full(params, xs, nmb):
+        outs = pipeline_apply_interleaved(stage_fn, params, xs,
+                                          nmb, n_chunks, axis_name)
         loss = loss_head(outs)
         return jnp.where(rank == n_stages - 1, loss, 0.0)
 
-    loss, grads = jax.value_and_grad(full)(chunk_params)
+    if microbatch_group_size is None:
+        return jax.value_and_grad(full)(chunk_params, x, n_microbatches)
+
+    G = microbatch_group_size
+    if G % n_stages != 0 or n_microbatches % G != 0:
+        raise ValueError(
+            f"microbatch_group_size ({G}) must be a multiple of the "
+            f"pipeline size ({n_stages}) dividing n_microbatches "
+            f"({n_microbatches})")
+    xg = x.reshape((n_microbatches // G, G) + x.shape[1:])
+
+    def group(carry, xs):
+        loss_sum, grads = carry
+        loss, g = jax.value_and_grad(full)(chunk_params, xs, G)
+        return (loss_sum + loss, jax.tree.map(jnp.add, grads, g)), None
+
+    zero = (jnp.zeros(()), jax.tree.map(jnp.zeros_like, chunk_params))
+    (loss, grads), _ = jax.lax.scan(group, zero, xg)
     return loss, grads
 
 

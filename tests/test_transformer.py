@@ -323,6 +323,124 @@ def test_pipeline_interleaved_validation_and_dispatch():
     ps.destroy_model_parallel()
 
 
+def _pipeline_grad_probe(which, nmb, PP=4, group=None):
+    """Jitted shard_map running one fwd+bwd of a residual-MLP stage
+    pipeline with the given schedule; returns (jitted_fn, args)."""
+    from apex_tpu.transformer.pipeline_parallel import schedules as S
+
+    mb, seq, h = 2, 16, 32
+    mesh = ps.get_mesh()
+    rng = np.random.RandomState(0)
+    w1 = jnp.asarray(rng.randn(PP, h, 2 * h) * 0.2, jnp.float32)
+    w2 = jnp.asarray(rng.randn(PP, 2 * h, h) * 0.2, jnp.float32)
+    x = jnp.asarray(rng.randn(nmb, mb, seq, h), jnp.float32)
+
+    def stage_fn(params, hid):
+        a, b = params
+        return hid + jnp.tanh(hid @ a) @ b
+
+    def loss_head(outs):
+        return jnp.sum(outs ** 2)
+
+    def loss_mb(out):
+        return jnp.sum(out ** 2)
+
+    def run(w1s, w2s, x):
+        params = (w1s[0], w2s[0])
+        if which == "fill_drain":
+            loss, g = S.forward_backward_pipelining_without_interleaving(
+                stage_fn, loss_head, params, x, nmb)
+        elif which == "1f1b":
+            loss, g = S.forward_backward_pipelining_1f1b(
+                stage_fn, loss_mb, params, x, nmb)
+        else:  # interleaved over vpp=1 chunks (exercise the group path)
+            loss, g = S.forward_backward_pipelining_with_interleaving(
+                stage_fn, loss_head,
+                jax.tree.map(lambda p: p[None], params), x, nmb,
+                n_chunks=1, microbatch_group_size=group)
+            g = jax.tree.map(lambda p: p[0], g)
+        return (jax.lax.psum(loss, "pipeline"), (g[0][None], g[1][None]))
+
+    fn = jax.jit(shard_map(
+        run, mesh=mesh,
+        in_specs=(P("pipeline"), P("pipeline"), P()),
+        out_specs=(P(), (P("pipeline"), P("pipeline"))), check_vma=False))
+    return fn, (w1, w2, x)
+
+
+def test_pipeline_1f1b_matches_fill_drain():
+    """The explicit-VJP 1F1B schedule must reproduce the grad-of-scan
+    fill-drain gradients and loss exactly (both are exact schedules of
+    the same computation)."""
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(pipeline_model_parallel_size_=4)
+    fd, args = _pipeline_grad_probe("fill_drain", nmb=8)
+    f1, _ = _pipeline_grad_probe("1f1b", nmb=8)
+    loss_fd, g_fd = fd(*args)
+    loss_1f, g_1f = f1(*args)
+    np.testing.assert_allclose(float(loss_1f), float(loss_fd), rtol=1e-5)
+    for a, b in zip(g_fd, g_1f):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5)
+    ps.destroy_model_parallel()
+
+
+def test_pipeline_interleaved_grouped_matches_ungrouped():
+    """microbatch_group_size (staged grads) must not change loss or
+    grads — only the memory schedule. loss_head here sums over
+    microbatches, so group losses add exactly."""
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(pipeline_model_parallel_size_=4)
+    ug, args = _pipeline_grad_probe("interleaved", nmb=16, group=None)
+    gr, _ = _pipeline_grad_probe("interleaved", nmb=16, group=4)
+    loss_u, g_u = ug(*args)
+    loss_g, g_g = gr(*args)
+    np.testing.assert_allclose(float(loss_g), float(loss_u), rtol=1e-5)
+    for a, b in zip(g_u, g_g):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5)
+    ps.destroy_model_parallel()
+
+
+def test_pipeline_memory_discipline():
+    """VERDICT r3 #6: peak activation (temp) memory of the schedules as
+    n_microbatches grows 2 -> 32, from XLA's compiled memory analysis.
+
+    - 1F1B must be FLAT: its only cross-tick activation state is the
+      2P-slot input stash, constant in nmb.
+    - staged-grads interleaved (group=P) must grow only with the
+      [nmb, ...] input/collect buffers (slope bounded by a few
+      microbatch-sizes per microbatch), not with per-tick residuals.
+    - fill-drain documents its O(nmb) residual growth (the reason the
+      other two exist).
+    """
+    ps.destroy_model_parallel()
+    ps.initialize_model_parallel(pipeline_model_parallel_size_=4)
+    mb_bytes = 2 * 16 * 32 * 4  # one microbatch activation, fp32
+
+    def temp_bytes(which, nmb, group=None):
+        fn, args = _pipeline_grad_probe(which, nmb, group=group)
+        ma = fn.lower(*args).compile().memory_analysis()
+        return ma.temp_size_in_bytes
+
+    lo, hi = temp_bytes("1f1b", 2), temp_bytes("1f1b", 32)
+    assert hi - lo <= 2 * mb_bytes, (
+        f"1F1B temp memory grew {lo} -> {hi} over nmb 2 -> 32; "
+        f"expected flat (<= 2 microbatch sizes of slack)")
+
+    lo_g, hi_g = (temp_bytes("interleaved", 4, group=4),
+                  temp_bytes("interleaved", 32, group=4))
+    # collect/inject buffers are [nmb, ...]; the scan double-buffers
+    # them, so allow a few microbatch-sizes per added microbatch — but
+    # NOT the ~1-residual-per-tick slope of the ungrouped schedule.
+    assert hi_g - lo_g <= 28 * 6 * mb_bytes, (
+        f"grouped interleaved temp memory grew {lo_g} -> {hi_g}")
+
+    lo_fd, hi_fd = temp_bytes("fill_drain", 2), temp_bytes("fill_drain", 32)
+    assert hi_fd > lo_fd  # the measured O(nmb) growth motivating 1F1B
+    ps.destroy_model_parallel()
+
+
 def test_gpt_sequence_parallel_grads_match_plain_tp():
     """The SP backward path (reduce-scatter gather VJP + tensor-axis
     reduction of LN/bias partials) must reproduce plain-TP gradients.
