@@ -197,6 +197,13 @@ def initialize(
         warn_or_err("keep_batchnorm_fp32 only makes sense with a cast_model_type (O2/O3).")
     if properties.master_weights and properties.cast_model_type is None:
         warn_or_err("master_weights requires cast_model_type (O2).")
+    if properties.cast_ops:
+        maybe_print(
+            "O1 scope: casts cover flax module calls (the default cast "
+            "lists incl. apex_tpu layer classes), apex_tpu.ops, and "
+            "functions you register — NOT raw jnp.*/lax.* calls in your "
+            "own code (no patchable namespace in JAX; docs/amp.md). "
+            "Raw-jnp models should use O2/O3 or amp.half_function.", True)
 
     _amp_state.opt_properties = properties
 

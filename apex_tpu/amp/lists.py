@@ -98,7 +98,12 @@ def _cast_float_arrays(tree, dtype):
 def o1_interceptor(next_fun: Callable, args, kwargs, context):
     """``nn.intercept_methods`` interceptor applying the cast table."""
     p = _policy_mod.current_policy()
-    if p is None or not p.enabled or context.method_name != "__call__":
+    # "attend" is the embedding-transpose logits matmul (flax nn.Embed /
+    # VocabParallelEmbedding) — matmul-class with a float input, so it
+    # must see the half policy like __call__ does (it is the single
+    # largest matmul of a GPT step).
+    if (p is None or not p.enabled
+            or context.method_name not in ("__call__", "attend")):
         return next_fun(*args, **kwargs)
     mod = context.module
     action = module_cast_action(mod)

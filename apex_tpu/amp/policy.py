@@ -144,6 +144,28 @@ def promote_function(fn: Callable) -> Callable:
     return wrapper
 
 
+def dtype_transparent(reason: str) -> Callable:
+    """Mark an op as deliberately NOT cast under autocast.
+
+    The reference puts softmax/norm/loss ops on FP32_FUNCS
+    (``apex/amp/lists/functional_overrides.py:44-62``) because their CUDA
+    kernels are precision-fragile in fp16. The apex_tpu equivalents
+    upcast *internally* (stats/exp/log-sum-exp accumulate in fp32
+    regardless of input dtype), so input casts would only add HBM
+    round trips without changing numerics. This decorator records that
+    audited decision on the function (``__amp_cast__ = "match_input"``)
+    so the O1 coverage audit (`tests/test_amp.py`) can tell "deliberately
+    transparent" from "forgot to register".
+    """
+
+    def deco(fn: Callable) -> Callable:
+        fn.__amp_cast__ = "match_input"
+        fn.__amp_cast_reason__ = reason
+        return fn
+
+    return deco
+
+
 def _register(module, name, deco):
     fn = getattr(module, name)
     if getattr(fn, "__amp_cast__", None) is None:

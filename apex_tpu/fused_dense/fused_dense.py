@@ -53,3 +53,9 @@ class FusedDenseGeluDense(nn.Module):
         return linear_gelu_linear(
             x, w1.astype(x.dtype), b1.astype(x.dtype),
             w2.astype(x.dtype), b2.astype(x.dtype))
+
+# O1 default-cast coverage: matmul-class (FP16_FUNCS row); the modules
+# compute in x.dtype, so the input cast carries the policy.
+from apex_tpu.amp import lists as _amp_lists  # noqa: E402
+_amp_lists.register_half_module(FusedDense)
+_amp_lists.register_half_module(FusedDenseGeluDense)

@@ -38,3 +38,7 @@ class MLP(nn.Module):
                 b = jnp.zeros((fan_out,), self.param_dtype)
             biases.append(b.astype(x.dtype))
         return mlp_forward(x, weights, biases, self.activation)
+
+# O1 default-cast coverage: matmul-class (FP16_FUNCS row).
+from apex_tpu.amp import lists as _amp_lists  # noqa: E402
+_amp_lists.register_half_module(MLP)

@@ -93,3 +93,9 @@ class MixedFusedRMSNorm(FusedRMSNorm):
         shape = _as_shape(self.normalized_shape)
         weight = self.param("weight", nn.initializers.ones, shape, self.param_dtype)
         return fused_rms_norm_affine(x, weight.astype(x.dtype), shape, self.eps)
+
+# O1 default-cast coverage: norms are FP32-class under autocast (the
+# reference's FP32_FUNCS row) — inputs cast up, compute dtype pinned fp32.
+from apex_tpu.amp import lists as _amp_lists  # noqa: E402
+_amp_lists.register_float_module(FusedLayerNorm)
+_amp_lists.register_float_module(FusedRMSNorm)

@@ -792,7 +792,10 @@ def _fa_bwd(causal, scale, dropout_rate, block_q, block_k, interpret,
 
 _flash_attention.defvjp(_fa_fwd, _fa_bwd)
 
+from apex_tpu.amp.policy import half_function  # noqa: E402  (amp has no ops imports; placed here to keep kernel code import-light)
 
+
+@half_function
 def flash_attention(q, k, v, segment_ids_q=None, segment_ids_kv=None,
                     causal: bool = False, scale: Optional[float] = None,
                     bias=None, dropout_rate: float = 0.0,

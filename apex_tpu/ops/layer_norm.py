@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.amp.policy import dtype_transparent
+
 
 def _norm_axes(x, normalized_shape):
     if isinstance(normalized_shape, int):
@@ -48,6 +50,7 @@ def _stats(x32, axes):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@dtype_transparent('stats accumulate in fp32 at any input dtype (module docstring)')
 def fused_layer_norm_affine(x, weight, bias, normalized_shape, eps=1e-5,
                             out_dtype=None):
     """LayerNorm with affine params; output dtype follows ``weight`` dtype
@@ -102,6 +105,7 @@ fused_layer_norm_affine.defvjp(_ln_fwd_affine_vjp, _ln_bwd_affine)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+@dtype_transparent('stats accumulate in fp32 at any input dtype (module docstring)')
 def fused_layer_norm(x, normalized_shape, eps=1e-5):
     """Non-affine LayerNorm (``csrc/layer_norm_cuda.cpp:260`` ``forward``)."""
     axes = _norm_axes(x, normalized_shape)
@@ -136,6 +140,7 @@ fused_layer_norm.defvjp(_ln_fwd, _ln_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+@dtype_transparent('stats accumulate in fp32 at any input dtype (module docstring)')
 def fused_rms_norm_affine(x, weight, normalized_shape, eps=1e-5):
     """RMSNorm with affine weight (newer apex ``fused_rms_norm_affine``,
     ``apex/normalization/fused_layer_norm.py`` upstream API parity)."""
@@ -175,6 +180,7 @@ def _rms_bwd(normalized_shape, eps, res, dy):
 fused_rms_norm_affine.defvjp(_rms_fwd_vjp, _rms_bwd)
 
 
+@dtype_transparent('stats accumulate in fp32 at any input dtype (module docstring)')
 def fused_rms_norm(x, normalized_shape, eps=1e-5):
     axes = _norm_axes(x, normalized_shape)
     x32 = x.astype(jnp.float32)

@@ -55,6 +55,8 @@ from jax.experimental.pallas import tpu as pltpu
 from apex_tpu.ops.flash_attention import _resolve_interpret
 from apex_tpu.transformer import parallel_state as ps
 
+from apex_tpu.amp.policy import dtype_transparent
+
 _NEG_INF = -1e30
 
 # Mosaic's default scoped-VMEM budget is 16 MB; the backward's resident
@@ -287,6 +289,7 @@ def _fused_ce_bwd(label_smoothing, axis_name, block_t, block_v, v_local,
 _fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
 
 
+@dtype_transparent('vocab-chunked logits+CE reduce in fp32 internally')
 def fused_lm_head_cross_entropy(
         x, embedding, targets, label_smoothing: float = 0.0,
         axis_name: Optional[str] = None,

@@ -17,6 +17,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.amp.policy import dtype_transparent
+
 
 def _softmax_fwd_math(scores32):
     m = jnp.max(scores32, axis=-1, keepdims=True)
@@ -25,6 +27,7 @@ def _softmax_fwd_math(scores32):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+@dtype_transparent('scale/max/exp run in fp32 internally; output in input dtype')
 def scaled_masked_softmax(x, mask, scale):
     """softmax(x*scale masked by additive -inf where ``mask`` is True).
 
@@ -57,6 +60,7 @@ scaled_masked_softmax.defvjp(_sms_fwd, _sms_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+@dtype_transparent('scale/max/exp run in fp32 internally; output in input dtype')
 def scaled_upper_triang_masked_softmax(x, scale):
     """Causal (upper-triangular masked) scaled softmax for [..., sq, sk]
     (``csrc/megatron/scaled_upper_triang_masked_softmax.h``)."""

@@ -17,8 +17,11 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.amp.policy import dtype_transparent
+
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+@dtype_transparent('log-sum-exp reduces in fp32; grad emitted in logits dtype')
 def softmax_cross_entropy_with_smoothing(logits, labels, smoothing=0.0,
                                          padding_idx: int | None = None):
     """Per-example loss. ``logits``: [..., V]; ``labels``: int [...].

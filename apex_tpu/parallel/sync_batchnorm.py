@@ -174,3 +174,7 @@ def convert_syncbn_model(module, process_group=None, channel_last=True):
         if changes:
             return module.replace(**changes) if hasattr(module, "replace") else module
     return module
+
+# O1 default-cast coverage: BN runs fp32 under autocast (FP32_FUNCS row).
+from apex_tpu.amp import lists as _amp_lists  # noqa: E402
+_amp_lists.register_float_module(SyncBatchNorm)

@@ -253,3 +253,16 @@ class VocabParallelEmbedding(nn.Module):
         ``vocab_parallel_cross_entropy`` does its reductions in fp32.
         """
         return jnp.einsum("...h,vh->...v", x, self.embedding.astype(x.dtype))
+
+# O1 default-cast coverage: TP projections are matmul-class (the
+# FP16_FUNCS row). The layers compute in x.dtype (kernel.astype(x.dtype)
+# above), so the interceptor's input cast alone moves them to the policy
+# half dtype; fp32 param storage is untouched (O1 master weights).
+# VocabParallelEmbedding's __call__ takes integer ids (the cast is a
+# no-op there), but its ``attend`` — the LM-head logits matmul, the
+# largest matmul of a GPT step — takes float hiddens, and the
+# interceptor covers attend too.
+from apex_tpu.amp import lists as _amp_lists  # noqa: E402
+_amp_lists.register_half_module(ColumnParallelLinear)
+_amp_lists.register_half_module(RowParallelLinear)
+_amp_lists.register_half_module(VocabParallelEmbedding)

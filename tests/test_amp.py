@@ -284,6 +284,86 @@ def test_o1_float_list_wins_inside_half_model():
     assert out.dtype == jnp.float32
 
 
+def test_o1_coverage_audit():
+    """VERDICT r3 #10: every public `apex_tpu.ops` entry point must carry
+    an audited `__amp_cast__` policy — "half"/"float"/"promote" (wrapped)
+    or "match_input" (deliberately dtype-transparent, with a recorded
+    reason) — and every apex_tpu flax layer class used by the models must
+    resolve through the O1 module cast table."""
+    import inspect
+    import apex_tpu.ops as ops
+    from apex_tpu.amp import lists as amp_lists
+
+    missing = []
+    for name in dir(ops):
+        if name.startswith("_"):
+            continue
+        fn = getattr(ops, name)
+        if not callable(fn) or inspect.isclass(fn) or inspect.ismodule(fn):
+            continue
+        tag = getattr(fn, "__amp_cast__", None)
+        if tag is None:
+            missing.append(name)
+        elif tag == "match_input":
+            assert getattr(fn, "__amp_cast_reason__", ""), name
+    assert not missing, f"ops without an amp cast policy: {missing}"
+
+    from apex_tpu.transformer.tensor_parallel import (
+        ColumnParallelLinear, RowParallelLinear)
+    from apex_tpu.normalization import FusedLayerNorm, FusedRMSNorm
+    from apex_tpu.parallel import SyncBatchNorm
+    from apex_tpu.fused_dense import FusedDense, FusedDenseGeluDense
+    from apex_tpu.mlp import MLP
+
+    def class_action(cls):
+        for c in amp_lists._FLOAT_MODULES:
+            if issubclass(cls, c):
+                return "float"
+        for c in amp_lists._HALF_MODULES:
+            if issubclass(cls, c):
+                return "half"
+        return None
+
+    for cls in (ColumnParallelLinear, RowParallelLinear, FusedDense,
+                FusedDenseGeluDense, MLP):
+        assert class_action(cls) == "half", cls.__name__
+    for cls in (FusedLayerNorm, FusedRMSNorm, SyncBatchNorm):
+        assert class_action(cls) == "float", cls.__name__
+
+
+def test_o1_covers_tp_layer_model():
+    """A model built from apex_tpu's own layer classes (the GPT/BERT
+    building blocks) gets O1 out of the box: projection dots run bf16,
+    FusedLayerNorm output pins fp32, param storage stays fp32."""
+    from apex_tpu.transformer.tensor_parallel import ColumnParallelLinear
+    from apex_tpu.normalization import FusedLayerNorm
+
+    from apex_tpu.transformer.tensor_parallel import VocabParallelEmbedding
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, ids):
+            emb = VocabParallelEmbedding(num_embeddings=32,
+                                         embedding_dim=16)
+            x = emb(ids)
+            x = ColumnParallelLinear(input_size=16, output_size=32)(x)
+            x = FusedLayerNorm(normalized_shape=32)(x)
+            x = ColumnParallelLinear(input_size=32, output_size=16)(x)
+            # the LM-head matmul: float input through a non-__call__
+            # method (the O1 interceptor must cover ``attend`` too)
+            return emb.attend(x)
+
+    m = Net()
+    ids = jnp.zeros((4, 8), jnp.int32)
+    v = m.init(jax.random.PRNGKey(0), ids)
+    am, _ = amp.initialize(lambda v, ids: m.apply(v, ids), FusedSGD(lr=0.1),
+                           opt_level="O1", verbosity=0)
+    dots = _collect_dots(lambda v, ids: am(v, ids), v, ids)
+    assert dots and all(d == (jnp.bfloat16, jnp.bfloat16) for d in dots)
+    assert all(l.dtype == jnp.float32
+               for l in jax.tree_util.tree_leaves(am.cast_params(v)))
+
+
 def test_o2_master_checkpoint_roundtrip():
     """O2 checkpoints are fp32 (O2StateDictHook analog) and restoring
     continues bitwise (VERDICT r1 missing #5)."""
