@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.utils.remat import resolve_remat_policy
 from apex_tpu.ops import flash_attention
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.enums import AttnMaskType
@@ -44,6 +45,9 @@ class BertConfig:
     dtype: Any = jnp.bfloat16
     use_flash: bool = True
     remat_blocks: bool = False
+    # see GPTConfig.remat_policy: None = full recompute, "dots" = save
+    # matmul outputs, recompute the elementwise/LN chains in backward
+    remat_policy: Optional[str] = None
     # Megatron-SP (see gpt.py): activations between layers are
     # sequence-sharded over the tensor axis
     sequence_parallel: bool = False
@@ -188,7 +192,11 @@ class Bert(nn.Module):
             x = tp_mappings.scatter_to_sequence_parallel_region(
                 x, ps.TENSOR_AXIS, 1)
 
-        layer_cls = nn.remat(BertLayer) if cfg.remat_blocks else BertLayer
+        if cfg.remat_blocks:
+            layer_cls = nn.remat(
+                BertLayer, policy=resolve_remat_policy(cfg.remat_policy))
+        else:
+            layer_cls = BertLayer
         for i in range(cfg.num_layers):
             x = layer_cls(cfg, name=f"layer_{i}")(x, pad_mask)
 

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.utils.remat import resolve_remat_policy
 from apex_tpu.ops.flash_attention import flash_attention
 from apex_tpu.ops.lm_head_ce import fused_lm_head_cross_entropy
 from apex_tpu.transformer import parallel_state as ps
@@ -55,6 +56,14 @@ class GPTConfig:
     ffn_hidden_size: Optional[int] = None   # default 4*hidden
     dtype: Any = jnp.bfloat16
     remat_blocks: bool = False
+    # remat_policy (with remat_blocks=True): None = full recompute;
+    # "dots" = jax.checkpoint_policies.checkpoint_dots — matmul outputs
+    # are SAVED, only the elementwise/LN/gelu chains between them
+    # recompute in backward. On an HBM-bound step this trades cheap VPU
+    # recompute for the write+read of the per-layer [b, s, 4h] gelu
+    # output and the LN outputs (a pure traffic saving at fp32/bf16
+    # activation sizes where full remat would cost real MXU time).
+    remat_policy: Optional[str] = None
     attention_impl: str = "flash"           # "flash" | "fused_softmax"
     # Megatron dropout knobs (--attention-dropout / --hidden-dropout,
     # apex/transformer/tensor_parallel/tests/arguments.py:345-348).
@@ -350,8 +359,11 @@ class GPT(nn.Module):
                 x, ps.TENSOR_AXIS, 1)
         # static_argnums: `deterministic` is a Python bool branching the
         # dropout guards — it must stay static through remat
-        block_cls = (nn.remat(GPTBlock, static_argnums=(2,))
-                     if cfg.remat_blocks else GPTBlock)
+        if cfg.remat_blocks:
+            block_cls = nn.remat(GPTBlock, static_argnums=(2,),
+                                 policy=resolve_remat_policy(cfg.remat_policy))
+        else:
+            block_cls = GPTBlock
         for i in range(cfg.num_layers):
             use_moe = bool(cfg.moe_num_experts) and (
                 i % cfg.moe_every == cfg.moe_every - 1)

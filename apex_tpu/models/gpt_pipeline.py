@@ -290,7 +290,8 @@ class PipelinedGPT:
         return loss
 
     def loss_and_grads(self, params, ids_mb, labels_mb,
-                       loss_scale: Optional[jax.Array] = None):
+                       loss_scale: Optional[jax.Array] = None,
+                       microbatch_group_size: Optional[int] = None):
         """Interleaved-pipeline forward+backward.
 
         Returns ``(loss, grads)`` where ``loss`` is the (unscaled) scalar
@@ -300,13 +301,50 @@ class PipelinedGPT:
         stages). When ``loss_scale`` is given the backward runs on the
         scaled loss and the returned grads are SCALED (unscale via the amp
         scaler, which also does the found-inf skip logic).
-        """
-        def full(p):
-            loss = self._loss_of(p, ids_mb, labels_mb)
-            scaled = loss * loss_scale if loss_scale is not None else loss
-            return scaled, loss
 
-        grads, loss = jax.grad(full, has_aux=True)(params)
+        ``microbatch_group_size`` (staged grads — the memory lever from
+        ``docs/perf.md``): differentiating through the full schedule
+        stashes one stage-input residual per tick, so peak activation
+        memory grows with ``n_microbatches``. A group size ``G`` (a
+        multiple of pp dividing ``n_microbatches``) runs the schedule G
+        microbatches at a time in an outer non-differentiated scan with
+        gradients accumulated in the carry — O(G·mb) residuals for one
+        extra (pp-1)-tick bubble per group. Loss and grads are exactly
+        the ungrouped values (each group's loss is a mean over its own
+        tokens; the group sum is divided by the group count)."""
+        def full_of(ids_x, labels_x):
+            def full(p):
+                loss = self._loss_of(p, ids_x, labels_x)
+                scaled = loss * loss_scale if loss_scale is not None else loss
+                return scaled, loss
+            return full
+
+        if microbatch_group_size is None:
+            grads, loss = jax.grad(full_of(ids_mb, labels_mb),
+                                   has_aux=True)(params)
+        else:
+            G = microbatch_group_size
+            nmb = ids_mb.shape[0]
+            if G % self.pp != 0 or nmb % G != 0:
+                raise ValueError(
+                    f"microbatch_group_size ({G}) must be a multiple of "
+                    f"pp ({self.pp}) dividing n_microbatches ({nmb})")
+            n_groups = nmb // G
+            ids_g = ids_mb.reshape((n_groups, G) + ids_mb.shape[1:])
+            labels_g = labels_mb.reshape((n_groups, G) + labels_mb.shape[1:])
+
+            def group(carry, xs):
+                loss_sum, gacc = carry
+                ids_x, labels_x = xs
+                g, l = jax.grad(full_of(ids_x, labels_x),
+                                has_aux=True)(params)
+                return (loss_sum + l, jax.tree.map(jnp.add, gacc, g)), None
+
+            zero = (jnp.zeros(()), jax.tree.map(jnp.zeros_like, params))
+            (loss, grads), _ = jax.lax.scan(group, zero, (ids_g, labels_g))
+            inv = 1.0 / n_groups
+            loss = loss * inv
+            grads = jax.tree.map(lambda g: g * inv, grads)
         grads["embed"] = jax.lax.psum(grads["embed"], self.axis_name)
         grads["head"] = jax.lax.psum(grads["head"], self.axis_name)
         if ps.sequence_parallel_active(self.cfg.sequence_parallel):

@@ -1,0 +1,21 @@
+"""Shared remat-policy resolution for model configs."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def resolve_remat_policy(name: Optional[str]):
+    """Map a config-level remat policy name to a jax.checkpoint policy.
+
+    ``None`` = full recompute; ``"dots"`` = save matmul outputs and
+    recompute the elementwise/LN chains in backward
+    (``jax.checkpoint_policies.checkpoint_dots``).
+    """
+    if name is None:
+        return None
+    if name == "dots":
+        return jax.checkpoint_policies.checkpoint_dots
+    raise ValueError(f"unknown remat_policy {name!r}; expected None or 'dots'")

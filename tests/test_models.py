@@ -98,12 +98,14 @@ class TestDCGAN:
             assert leaf.dtype == jnp.float32
 
 
-@pytest.mark.parametrize("remat", [False, True])
-def test_gpt_remat_matches(remat):
-    """jax.checkpoint'd blocks are numerically identical."""
+@pytest.mark.parametrize("remat,policy", [(False, None), (True, None),
+                                          (True, "dots")])
+def test_gpt_remat_matches(remat, policy):
+    """jax.checkpoint'd blocks are numerically identical (full recompute
+    and the save-dots selective policy); grads too."""
     cfg = GPTConfig(vocab_size=64, max_seq_len=16, hidden_size=32,
                     num_layers=2, num_heads=2, dtype=jnp.float32,
-                    remat_blocks=remat)
+                    remat_blocks=remat, remat_policy=policy)
     m = GPT(cfg)
     ids = jnp.zeros((1, 8), jnp.int32)
     v = GPT(GPTConfig(vocab_size=64, max_seq_len=16, hidden_size=32,
@@ -115,6 +117,15 @@ def test_gpt_remat_matches(remat):
                         dtype=jnp.float32)).apply(v, ids)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+    labels = jnp.ones((1, 8), jnp.int32)
+    g = jax.grad(lambda v: m.loss(v, ids, labels))(v)
+    g_ref = jax.grad(lambda v: GPT(GPTConfig(
+        vocab_size=64, max_seq_len=16, hidden_size=32, num_layers=2,
+        num_heads=2, dtype=jnp.float32)).loss(v, ids, labels))(v)
+    for a, b in zip(jax.tree_util.tree_leaves(g_ref),
+                    jax.tree_util.tree_leaves(g)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-6)
 
 
 def test_gpt_flash_vs_fused_softmax_path():
@@ -245,6 +256,28 @@ def test_bert_loss_fused_lm_head_matches_unfused(smoothing):
         np.testing.assert_allclose(
             np.asarray(leaf), np.asarray(flat_r[path]), rtol=2e-4,
             atol=2e-5, err_msg=jax.tree_util.keystr(path))
+
+
+@pytest.mark.parametrize("policy", [None, "dots"])
+def test_bert_remat_matches(policy):
+    """Bert's remat branch (full recompute and the save-dots policy) is
+    numerically identical to no-remat, loss and grads."""
+    from apex_tpu.models.bert import BertConfig as BC
+    kw = dict(vocab_size=96, max_seq_len=16, hidden_size=32, num_layers=2,
+              num_heads=2, dtype=jnp.float32, use_flash=False)
+    m = Bert(BC(remat_blocks=True, remat_policy=policy, **kw))
+    ref = Bert(BC(**kw))
+    rs = np.random.RandomState(9)
+    ids = jnp.asarray(rs.randint(0, 96, (2, 8)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, 96, (2, 8)), jnp.int32)
+    v = ref.init(jax.random.PRNGKey(0), ids)
+    l, g = jax.value_and_grad(lambda v: m.loss(v, ids, labels))(v)
+    l_r, g_r = jax.value_and_grad(lambda v: ref.loss(v, ids, labels))(v)
+    np.testing.assert_allclose(float(l), float(l_r), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(g_r),
+                    jax.tree_util.tree_leaves(g)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-6)
 
 
 def test_bert_loss_mask_ignores_padding():
