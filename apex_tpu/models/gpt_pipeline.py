@@ -66,7 +66,7 @@ from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.ops.lm_head_ce import fused_lm_head_cross_entropy
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.pipeline_parallel.schedules import (
-    pipeline_apply_interleaved)
+    pipeline_apply_interleaved, staged_group_scan)
 from apex_tpu.transformer.tensor_parallel import (
     ColumnParallelLinear, VocabParallelEmbedding,
     mappings as tp_mappings, vocab_parallel_cross_entropy)
@@ -323,25 +323,17 @@ class PipelinedGPT:
             grads, loss = jax.grad(full_of(ids_mb, labels_mb),
                                    has_aux=True)(params)
         else:
-            G = microbatch_group_size
-            nmb = ids_mb.shape[0]
-            if G % self.pp != 0 or nmb % G != 0:
-                raise ValueError(
-                    f"microbatch_group_size ({G}) must be a multiple of "
-                    f"pp ({self.pp}) dividing n_microbatches ({nmb})")
-            n_groups = nmb // G
-            ids_g = ids_mb.reshape((n_groups, G) + ids_mb.shape[1:])
-            labels_g = labels_mb.reshape((n_groups, G) + labels_mb.shape[1:])
-
-            def group(carry, xs):
-                loss_sum, gacc = carry
+            def grad_of_group(xs):
                 ids_x, labels_x = xs
                 g, l = jax.grad(full_of(ids_x, labels_x),
                                 has_aux=True)(params)
-                return (loss_sum + l, jax.tree.map(jnp.add, gacc, g)), None
+                return g, l
 
-            zero = (jnp.zeros(()), jax.tree.map(jnp.zeros_like, params))
-            (loss, grads), _ = jax.lax.scan(group, zero, (ids_g, labels_g))
+            loss, grads, n_groups = staged_group_scan(
+                grad_of_group, params, (ids_mb, labels_mb),
+                ids_mb.shape[0], microbatch_group_size, self.pp)
+            # each group's loss is a mean over its own tokens; equal
+            # groups make the group-sum / n_groups the full-batch mean
             inv = 1.0 / n_groups
             loss = loss * inv
             grads = jax.tree.map(lambda g: g * inv, grads)

@@ -28,14 +28,18 @@ outside VMEM scratch.
 Shapes: q [b, h, sq, d]; k, v [b, h, sk, d]; segment_ids int32 [b, sq]
 ([b, sk] for kv if lengths differ). fp32 accumulation throughout.
 
-Default block sizes (1024, 1024) were tuned on a v5e chip (b8 h16 s1024
-d64 causal bf16 fwd+bwd): 1024-blocks run 1.45x faster than 512-blocks
-and ~1.9x faster than 256-blocks at s in {1024, 2048, 4096}; 2048-blocks
-exceed VMEM. When bias AND dropout are both active the default drops to
-(512, 512): the extra [block_q, block_k] fp32 bias block plus the keep
-mask push the 1024 config over VMEM on hardware (verified at d=128
-s=2048: bias-only ok, dropout-only ok, both fail). Blocks clamp to the
-sequence length for small shapes.
+Default block sizes, tuned on a v5e chip (b8 h16 d64 bf16 fwd+bwd):
+1024 for non-causal shapes (256-blocks are ~1.9x slower — per-program
+overhead; 2048-blocks exceed VMEM). Causal shapes default to two
+512-aligned blocks per sequence (min two blocks lets the causal
+live-block skip drop the fully-future block pair: full-GPT step at
+s=1024 measured 93.4 ms with one 1024-block vs 92.8 ms with (512,512);
+s >= 2048 keeps 1024-blocks, which already skip). When bias AND dropout
+are both active the default drops to (512, 512): the extra
+[block_q, block_k] fp32 bias block plus the keep mask push the 1024
+config over VMEM on hardware (verified at d=128 s=2048: bias-only ok,
+dropout-only ok, both fail). Blocks clamp to the sequence length for
+small shapes.
 """
 
 from __future__ import annotations

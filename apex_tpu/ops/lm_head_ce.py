@@ -60,12 +60,15 @@ from apex_tpu.amp.policy import dtype_transparent
 _NEG_INF = -1e30
 
 # Mosaic's default scoped-VMEM budget is 16 MB; the backward's resident
-# set at the swept-optimal tiles (bt=256, bv=1024, h=1024) is ~13 MB
-# standalone but is accounted ~19 MB when the kernel sits inside a
-# lax.while/scan body (loop state shares the scope). v5e VMEM is 128 MB;
-# 32 MB leaves the tiles at their measured-fastest sizes in both
-# contexts.
-_VMEM_LIMIT = 32 * 1024 * 1024
+# set at the swept-optimal tiles (bt=512, bv=2048, h=1024) is ~24 MB
+# standalone but the accounting grows when the kernel sits inside a
+# lax.while/scan or remat body (loop state shares the scope): measured
+# 41.84 MB at s=8192 under remat_blocks — which a 32 MB cap rejected
+# (r4 regression of the long-seq-remat path, caught by the s=8192
+# re-verify). v5e VMEM is 128 MB; 64 MB keeps the measured-fastest
+# tiles valid in every shipping context with headroom for the
+# compiler's own buffers.
+_VMEM_LIMIT = 64 * 1024 * 1024
 _COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
 
 

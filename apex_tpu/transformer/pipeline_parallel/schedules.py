@@ -216,6 +216,40 @@ def forward_backward_pipelining_1f1b(
     return loss_sum, grads
 
 
+def staged_group_scan(grad_of_group: Callable, params, xs,
+                      n_microbatches: int, group_size: int, n_stages: int):
+    """Shared staged-grads accumulator (the memory lever of
+    ``microbatch_group_size`` — see docs/perf.md).
+
+    Splits every leaf of ``xs`` ([n_microbatches, ...]) into
+    ``n_microbatches // group_size`` groups and runs
+    ``grad_of_group(xs_group) -> (grads, loss)`` over them in an outer
+    NON-differentiated ``lax.scan``, accumulating both in the carry —
+    peak activation residuals are O(group_size·mb) instead of
+    O(n_microbatches·mb). Returns ``(loss_sum, grads_sum, n_groups)``
+    with RAW SUMS over groups; the caller owns the normalization (the
+    schedule-level API documents the sum, the model-level API divides
+    by ``n_groups``).
+    """
+    if group_size % n_stages != 0 or n_microbatches % group_size != 0:
+        raise ValueError(
+            f"microbatch_group_size ({group_size}) must be a multiple of "
+            f"the pipeline size ({n_stages}) dividing n_microbatches "
+            f"({n_microbatches})")
+    n_groups = n_microbatches // group_size
+    xg = jax.tree.map(
+        lambda a: a.reshape((n_groups, group_size) + a.shape[1:]), xs)
+
+    def group(carry, xs_g):
+        loss_sum, gacc = carry
+        g, l = grad_of_group(xs_g)
+        return (loss_sum + l, jax.tree.map(jnp.add, gacc, g)), None
+
+    zero = (jnp.zeros(()), jax.tree.map(jnp.zeros_like, params))
+    (loss, grads), _ = jax.lax.scan(group, zero, xg)
+    return loss, grads, n_groups
+
+
 def pipeline_apply_interleaved(stage_fn: Callable, chunk_params, x,
                                n_microbatches: int, n_chunks: int,
                                axis_name: str = ps.PIPELINE_AXIS,
@@ -342,20 +376,13 @@ def forward_backward_pipelining_with_interleaving(
         return jax.value_and_grad(full)(chunk_params, x, n_microbatches)
 
     G = microbatch_group_size
-    if G % n_stages != 0 or n_microbatches % G != 0:
-        raise ValueError(
-            f"microbatch_group_size ({G}) must be a multiple of the "
-            f"pipeline size ({n_stages}) dividing n_microbatches "
-            f"({n_microbatches})")
-    xg = x.reshape((n_microbatches // G, G) + x.shape[1:])
 
-    def group(carry, xs):
-        loss_sum, grads = carry
+    def grad_of_group(xs):
         loss, g = jax.value_and_grad(full)(chunk_params, xs, G)
-        return (loss_sum + loss, jax.tree.map(jnp.add, grads, g)), None
+        return g, loss
 
-    zero = (jnp.zeros(()), jax.tree.map(jnp.zeros_like, chunk_params))
-    (loss, grads), _ = jax.lax.scan(group, zero, xg)
+    loss, grads, _ = staged_group_scan(
+        grad_of_group, chunk_params, x, n_microbatches, G, n_stages)
     return loss, grads
 
 
