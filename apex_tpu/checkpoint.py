@@ -113,6 +113,48 @@ def load_checkpoint(path: str, like: Any) -> Any:
     return jax.tree_util.tree_unflatten(treedef, vals)
 
 
+def save_checkpoint_orbax(path: str, tree: Any, *,
+                          async_save: bool = False, checkpointer=None):
+    """Save via ``orbax.checkpoint`` — the multi-controller path.
+
+    The ``.npz`` saver above is single-host synchronous (the
+    ``torch.save`` analog). For multi-host training, orbax writes each
+    host's owned shards in parallel (every process must call this) and
+    ``async_save=True`` returns immediately while the write happens in
+    a background thread — the step loop keeps running, which is how
+    large-model checkpointing stays off the critical path on TPU pods.
+
+    Returns the checkpointer when ``async_save`` — the caller OWNS it:
+    call ``.close()`` when done (it waits for the in-flight write); a
+    loop checkpointing every N steps should keep ONE returned
+    checkpointer and pass it back via ``checkpointer=`` on subsequent
+    saves rather than growing a thread pool per call. Returns None for
+    sync saves (the checkpointer is closed internally).
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    if not async_save:
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path, tree, force=True)
+        return None
+    ckptr = checkpointer or ocp.AsyncCheckpointer(
+        ocp.StandardCheckpointHandler())
+    ckptr.save(path, tree, force=True)
+    return ckptr
+
+
+def load_checkpoint_orbax(path: str, like: Any) -> Any:
+    """Template-shaped restore of an orbax checkpoint (same contract as
+    ``load_checkpoint``: ``like`` supplies structure/shape/dtype — and,
+    for jax.Arrays with shardings, the target sharding, so a restore
+    onto a new mesh re-shards on read)."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(os.path.abspath(path), like)
+
+
 def save_train_state(path: str, *, params=None, opt_state=None,
                      scaler_state=None, extra=None) -> None:
     """The apex recipe (README.md:57-99) as one call: model + optimizer

@@ -56,9 +56,11 @@ class BertConfig:
     # for BERT by measurement: at BERT-base shape (V=30k, h=768,
     # 16k tokens) the backward's logit-tile recompute (~3.9 ms of extra
     # matmul) exceeds what the fusion saves — v5e full-step 128.6 ms
-    # unfused vs 130.4 ms best-tuned fused. Flip it on for large-vocab
-    # variants, where the saved [tokens, V] round trips dominate (GPT at
-    # V=32k/h=1024 measures the other way; see GPTConfig).
+    # unfused vs 130.4 ms best-tuned fused (re-confirmed r4 under the
+    # 64 MB kernel budget: 121.3 unfused vs 123.1-126.1 fused). Flip it
+    # on for large-vocab variants, where the saved [tokens, V] round
+    # trips dominate (GPT at V=32k/h=1024 measures the other way; see
+    # GPTConfig).
     fused_lm_head: bool = False
 
     @property

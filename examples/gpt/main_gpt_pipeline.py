@@ -44,6 +44,10 @@ def main():
     p.add_argument("--global-batch", type=int, default=8)
     p.add_argument("--rampup", type=int, nargs=3, metavar=("START", "INCR", "SAMPLES"),
                    help="global-batch-size rampup (Megatron --rampup-batch-size)")
+    p.add_argument("--microbatch-group-size", type=int, default=None,
+                   help="staged grads: run the schedule G microbatches "
+                        "at a time (multiple of pp) — bounds activation "
+                        "memory at O(G*mb); see docs/perf.md")
     p.add_argument("--seq", type=int, default=64)
     p.add_argument("--vocab", type=int, default=1024)
     p.add_argument("--hidden", type=int, default=128)
@@ -76,8 +80,9 @@ def main():
         return params, dopt.init(params), scaler_mod.init_state(2.0 ** 12)
 
     def train_step(params, opt_state, sstate, ids_mb, labels_mb):
-        loss, grads = pgpt.loss_and_grads(params, ids_mb, labels_mb,
-                                          loss_scale=sstate.loss_scale)
+        loss, grads = pgpt.loss_and_grads(
+            params, ids_mb, labels_mb, loss_scale=sstate.loss_scale,
+            microbatch_group_size=args.microbatch_group_size)
         # no dp pmean: DistributedFusedAdam's psum_scatter over the data
         # axis already averages (ZeRO); unscale is linear and commutes
         grads, found_inf = scaler_mod.unscale(grads, sstate)
