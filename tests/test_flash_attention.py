@@ -93,6 +93,29 @@ def test_flash_indivisible_lengths_padded():
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_flash_causal_default_blocks_odd_lengths():
+    """The r4 causal DEFAULT block rule (two 512-aligned blocks per
+    sequence for sq >= 1024) must stay numerically exact for sequence
+    lengths that are not block multiples — sq=1100 resolves the default
+    to 512 and pads to 1536; fwd and grads must match the reference."""
+    q, k, v = _qkv(b=1, h=2, sq=1100, sk=1100, d=8, seed=11)
+    out = flash_attention(q, k, v, causal=True)   # default block path
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+    def loss_flash(q):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    def loss_ref(q):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g = jax.grad(loss_flash)(q)
+    g_ref = jax.grad(loss_ref)(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_flash_negative_segment_ids_are_padding():
     """id < 0 rows: zero output, no influence on real rows, zero grads in."""
     b, h, s, d = 1, 2, 32, 8

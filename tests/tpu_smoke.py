@@ -66,6 +66,13 @@ def main():
         lambda s: scaled_masked_softmax(s, mask, 0.5))(s))
     logits = jax.random.normal(key, (256, 32000), jnp.float32)
     labels = jax.random.randint(key, (256,), 0, 32000)
+    from apex_tpu.ops.lm_head_ce import fused_lm_head_cross_entropy
+    hid = jax.random.normal(key, (1024, 256), jnp.bfloat16)
+    emb = jax.random.normal(key, (4096, 256), jnp.bfloat16)
+    tgt = jnp.arange(1024, dtype=jnp.int32) % 4096
+    ok &= _check("fused lm-head CE fwd+bwd", lambda: jax.jit(jax.grad(
+        lambda h, e: jnp.sum(fused_lm_head_cross_entropy(h, e, tgt)),
+        argnums=(0, 1)))(hid, emb))
     ok &= _check("xentropy+smoothing", lambda: jax.jit(jax.grad(
         lambda l: jnp.sum(softmax_cross_entropy_with_smoothing(
             l, labels, 0.1))))(logits))
