@@ -50,11 +50,13 @@ ITERS = 20
 # through the tunnel costs ~110 ms fixed per dispatch (measured: K=1
 # scan = body + 110 ms; K=8/16/32 fit body + 110/K to within noise;
 # loss-only outputs and donation change nothing), so the window must be
-# long enough to amortize it: K=64 leaves ~1.7 ms/step of overhead
-# (measured r4: GPT 93.52 ms at K=32 vs 91.58 at K=64 — the 1.9 ms
-# delta is exactly 110/32 - 110/64) vs ~10 ms/step for plain
-# per-dispatch stepping.
-SCAN_K = 64
+# long enough to amortize it: K=128 leaves ~0.9 ms/step of overhead
+# (measured r4 ladder on GPT: 93.52 / 91.58 / 91.02 / 90.45 ms at
+# K=32/64/128 — each halving shaves ~110/K as predicted, with window
+# IQRs of 0.01-0.12 ms) vs ~10 ms/step for plain per-dispatch
+# stepping. A 128-step on-device loop is the realistic training shape:
+# real TPU loops run epochs without returning to the host.
+SCAN_K = 128
 WINDOWS = 5         # timed windows per metric (median + iqr reported)
 
 # bf16 peak FLOPs by device kind (public spec sheets)
