@@ -66,7 +66,8 @@ from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.ops.lm_head_ce import fused_lm_head_cross_entropy
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.pipeline_parallel.schedules import (
-    pipeline_apply_interleaved, staged_group_scan)
+    forward_backward_pipelining_1f1b_model, pipeline_apply_interleaved,
+    staged_group_scan)
 from apex_tpu.transformer.tensor_parallel import (
     ColumnParallelLinear, VocabParallelEmbedding,
     mappings as tp_mappings, vocab_parallel_cross_entropy)
@@ -235,6 +236,23 @@ class PipelinedGPT:
                 h = block.apply(p, h, True)
         return h, aux
 
+    def _head_ce(self, head_params, hidden, labels):
+        """LM head + per-token CE (fused or vocab-parallel) — the one
+        place the head/CE pairing lives; both pipeline paths call it.
+        ``hidden``: [..., s_head, h] (the SP shard when active);
+        ``labels``: [..., s] global ids."""
+        if self.cfg.fused_lm_head:
+            h = self.head.apply({"params": head_params}, hidden,
+                                hidden_only=True)
+            # lm_head kernel is [h, V/tp]; the fused op takes the table
+            # [V/tp, h] — the transpose is one cheap pass, its autodiff
+            # routes dE back to the kernel layout
+            w = head_params["lm_head"]["kernel"].T
+            return fused_lm_head_cross_entropy(
+                h, w, labels, axis_name=ps.TENSOR_AXIS)
+        logits = self.head.apply({"params": head_params}, hidden)
+        return vocab_parallel_cross_entropy(logits, labels)
+
     def _loss_of(self, params, ids_mb, labels_mb):
         nmb, mb, s = ids_mb.shape
         x = self.embed.apply({"params": params["embed"]},
@@ -260,24 +278,10 @@ class PipelinedGPT:
         # the shard and its column layer gathers internally (one
         # tensor-axis reduction; see _Head)
         s_head = outs.shape[2]
-        if self.cfg.fused_lm_head:
-            hidden = self.head.apply(
-                {"params": params["head"]},
-                outs.reshape(nmb * mb, s_head, self.cfg.hidden_size),
-                hidden_only=True)
-            # lm_head kernel is [h, V/tp]; the fused op takes the table
-            # [V/tp, h] — the transpose is one cheap pass, its autodiff
-            # routes dE back to the kernel layout
-            w = params["head"]["lm_head"]["kernel"].T
-            losses = fused_lm_head_cross_entropy(
-                hidden, w, labels_mb.reshape(nmb * mb, s),
-                axis_name=ps.TENSOR_AXIS)
-        else:
-            logits = self.head.apply(
-                {"params": params["head"]},
-                outs.reshape(nmb * mb, s_head, self.cfg.hidden_size))
-            losses = vocab_parallel_cross_entropy(
-                logits, labels_mb.reshape(nmb * mb, s))
+        losses = self._head_ce(
+            params["head"],
+            outs.reshape(nmb * mb, s_head, self.cfg.hidden_size),
+            labels_mb.reshape(nmb * mb, s))
         loss = jnp.mean(losses)
         rank = jax.lax.axis_index(self.axis_name)
         n_stages = jax.lax.axis_size(self.axis_name)
@@ -348,4 +352,63 @@ class PipelinedGPT:
             grads["head"] = tp_mappings.allreduce_sequence_parallel_gradients(
                 grads["head"], GPT.sequence_parallel_grad_filter)
         loss = jax.lax.psum(loss, self.axis_name)
+        return loss, grads
+
+    def loss_and_grads_1f1b(self, params, ids_mb, labels_mb,
+                            loss_scale: Optional[jax.Array] = None):
+        """Flat-memory 1F1B forward+backward for the FULL GPT.
+
+        Same contract as ``loss_and_grads`` (loss replicated over pp
+        after its psum; embed/head grads psummed; chunk grads per-rank)
+        but through ``forward_backward_pipelining_1f1b_model``: peak
+        activation memory is a 2P-1-slot stash, constant in
+        ``n_microbatches``, instead of one stashed residual per tick.
+        Requires ``n_chunks == 1`` (1F1B is the non-interleaved
+        schedule), dense blocks (no MoE aux channel), and no sequence
+        parallelism (the pipe carries the full sequence).
+        """
+        if self.n_chunks != 1:
+            raise ValueError(
+                f"1F1B is the non-interleaved schedule: n_chunks must be "
+                f"1, got {self.n_chunks}")
+        if self.has_moe:
+            raise ValueError("1F1B path does not carry the MoE aux "
+                             "channel; use loss_and_grads")
+        if ps.sequence_parallel_active(self.cfg.sequence_parallel):
+            raise ValueError("1F1B path runs without sequence "
+                             "parallelism; use loss_and_grads")
+        nmb, mb, s = ids_mb.shape
+        cfg = self.cfg
+
+        def embed_fn(embed_params, inputs_mb):
+            ids, _ = inputs_mb
+            return self.embed.apply({"params": embed_params}, ids)
+
+        def stage_fn(stage_params, h):
+            # chunk leaves are [1, L, ...]: squeeze the chunk dim and
+            # reuse the interleaved path's stage body (dense guaranteed
+            # by the has_moe guard above)
+            return self.stage_fn(
+                jax.tree.map(lambda p: p[0], stage_params), h)
+
+        def loss_fn(head_params, h, inputs_mb):
+            _, labels = inputs_mb
+            losses = self._head_ce(head_params, h, labels)
+            loss = jnp.mean(losses) / nmb   # sum over mbs -> batch mean
+            if loss_scale is not None:
+                loss = loss * loss_scale
+            return loss
+
+        sched_params = {"embed": params["embed"],
+                        "stage": params["chunks"],
+                        "head": params["head"]}
+        loss, g = forward_backward_pipelining_1f1b_model(
+            embed_fn, stage_fn, loss_fn, sched_params,
+            (ids_mb, labels_mb), nmb, self.axis_name)
+        grads = {"embed": jax.lax.psum(g["embed"], self.axis_name),
+                 "chunks": g["stage"],
+                 "head": jax.lax.psum(g["head"], self.axis_name)}
+        loss = jax.lax.psum(loss, self.axis_name)
+        if loss_scale is not None:
+            loss = loss / loss_scale      # report the unscaled loss
         return loss, grads

@@ -21,5 +21,7 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
     forward_backward_pipelining_without_interleaving,
     forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_1f1b,
+    forward_backward_pipelining_1f1b_model,
+    staged_group_scan,
     get_forward_backward_func,
 )

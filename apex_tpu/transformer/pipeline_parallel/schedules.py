@@ -157,21 +157,81 @@ def forward_backward_pipelining_1f1b(
     ``loss_mb`` by ``n_microbatches`` for a mean). Returns
     ``(loss, grads)`` with the loss masked to the last rank — ``psum``
     both over the pipeline axis, exactly as with the fill-drain variant.
+
+    This is the headless special case of
+    ``forward_backward_pipelining_1f1b_model`` (identity injection from
+    ``x``, no embed/head parameters) — one tick core serves both.
+    """
+    loss, grads = forward_backward_pipelining_1f1b_model(
+        lambda _, x_mb: x_mb,                 # injection = x[m] directly
+        stage_fn,
+        lambda _, h, __: loss_mb(h),          # headless loss seed
+        {"embed": {}, "stage": stage_params, "head": {}},
+        x, n_microbatches, axis_name)
+    return loss, grads["stage"]
+
+
+def forward_backward_pipelining_1f1b_model(
+        embed_fn: Callable, stage_fn: Callable, loss_fn: Callable,
+        params, inputs, n_microbatches: int,
+        axis_name: str = ps.PIPELINE_AXIS):
+    """1F1B for a FULL model: embed + stages + loss head, flat memory.
+
+    ``forward_backward_pipelining_1f1b`` above handles the stage stack
+    only; a real model also needs gradients for the embedding (rank 0)
+    and the loss head (last rank). This variant runs the same two-stream
+    tick schedule with:
+
+    - ``embed_fn(params['embed'], inputs_mb) -> h``: computes the
+      injection for microbatch ``m`` (consumed on rank 0; every rank
+      computes it — embeddings are cheap and any collectives inside,
+      e.g. VocabParallelEmbedding's tensor-axis psum, stay collectively
+      consistent across the mesh this way).
+    - ``loss_fn(params['head'], h_out, inputs_mb) -> scalar``: the loss
+      head for one microbatch, run under ``lax.cond`` so ONLY the last
+      pipeline rank pays for it (at tp>1 its collectives span the
+      tensor axis within that pp row — group-local, so the other rows
+      skipping the branch is sound). Its gradient seeds the backward.
+    - embedding backward: rank 0's input cotangent, instead of being
+      dropped off the pipeline edge, pulls back through ``embed_fn``
+      (recomputed — ids index directly into ``inputs``, nothing extra
+      is stashed).
+
+    ``params``: dict with keys ``embed`` / ``stage`` / ``head``.
+    ``inputs``: pytree with [n_microbatches, ...] leaves (e.g.
+    ``(ids, labels)``) — sliced per unit for embed and loss.
+
+    Returns ``(loss_sum, grads)`` where ``grads`` has the same dict
+    structure; the loss and the embed/head grads live on their owning
+    ranks (zero elsewhere) — ``psum`` them over the pipeline axis, as
+    with ``PipelinedGPT.loss_and_grads``. ``loss_sum`` is the SUM of
+    per-microbatch losses (divide inside ``loss_fn`` for a mean).
+    Memory: the same 2P-1-slot activation stash as the plain 1F1B
+    schedule — peak activations constant in ``n_microbatches``.
     """
     n_microbatches = resolve_num_microbatches(n_microbatches)
     n_stages = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     is_last = rank == n_stages - 1
+    is_first = rank == 0
     delay = 2 * (n_stages - 1)
     total_ticks = n_microbatches + delay
     stash_slots = max(1, 2 * n_stages - 1)
 
-    h_shape = x.shape[1:]
+    def slice_mb(m):
+        return jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, m, keepdims=False),
+            inputs)
+
+    probe = jax.eval_shape(lambda p: embed_fn(p, slice_mb(0)),
+                           params["embed"])
+    h_shape, h_dtype = probe.shape, probe.dtype
+
     init = (
-        jnp.zeros(h_shape, x.dtype),                      # held_f
-        jnp.zeros(h_shape, x.dtype),                      # held_b (cotangent)
-        jnp.zeros((stash_slots,) + h_shape, x.dtype),     # input stash
-        jax.tree.map(jnp.zeros_like, stage_params),       # grad accumulator
+        jnp.zeros(h_shape, h_dtype),                      # held_f
+        jnp.zeros(h_shape, h_dtype),                      # held_b
+        jnp.zeros((stash_slots,) + h_shape, h_dtype),     # input stash
+        jax.tree.map(jnp.zeros_like, params),             # grad accumulator
         jnp.zeros((), jnp.float32),                       # loss sum
     )
 
@@ -182,12 +242,9 @@ def forward_backward_pipelining_1f1b(
         m_f = i - rank
         valid_f = (m_f >= 0) & (m_f < n_microbatches)
         m_fc = jnp.clip(m_f, 0, n_microbatches - 1)
-        inject = jax.lax.dynamic_index_in_dim(x, m_fc, keepdims=False)
-        inp = jnp.where(valid_f & (rank == 0), inject, held_f)
-        out = stage_fn(stage_params, inp)
-        # stash the stage input; on invalid ticks rewrite the slot's own
-        # value (read-modify-write keeps the update in place — a
-        # where() over the whole stash would copy all slots every tick)
+        inject = embed_fn(params["embed"], slice_mb(m_fc))
+        inp = jnp.where(valid_f & is_first, inject, held_f)
+        out = stage_fn(params["stage"], inp)
         slot = m_fc % stash_slots
         cur = jax.lax.dynamic_index_in_dim(stash, slot, keepdims=False)
         stash = jax.lax.dynamic_update_index_in_dim(
@@ -198,15 +255,51 @@ def forward_backward_pipelining_1f1b(
         m_b = i - delay + rank
         valid_b = (m_b >= 0) & (m_b < n_microbatches)
         m_bc = jnp.clip(m_b, 0, n_microbatches - 1)
+        in_b = slice_mb(m_bc)
         inp_b = jax.lax.dynamic_index_in_dim(
             stash, m_bc % stash_slots, keepdims=False)
-        out_b, pullback = jax.vjp(stage_fn, stage_params, inp_b)
-        loss_val, seed = jax.value_and_grad(loss_mb)(out_b)
-        g_out = jnp.where(is_last, seed.astype(out_b.dtype), held_b)
-        dparams, dinp = pullback(g_out)
-        grads = jax.tree.map(
-            lambda a, d: a + jnp.where(valid_b, d, 0), grads, dparams)
-        loss_sum = loss_sum + jnp.where(valid_b & is_last, loss_val, 0.0)
+        out_b, pull_stage = jax.vjp(stage_fn, params["stage"], inp_b)
+
+        def head_branch(hp, h, inb):
+            (loss, (dhp, dh)) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(hp, h, inb)
+            return loss, dhp, dh.astype(h.dtype)
+
+        def head_skip(hp, h, inb):
+            return (jnp.zeros((), jnp.float32),
+                    jax.tree.map(jnp.zeros_like, hp),
+                    jnp.zeros_like(h))
+
+        loss_val, dhead, seed = jax.lax.cond(
+            is_last & valid_b, head_branch, head_skip,
+            params["head"], out_b, in_b)
+
+        g_out = jnp.where(is_last, seed, held_b)
+        dstage, dinp = pull_stage(g_out)
+
+        def embed_branch(ep, inb, ct):
+            _, pull = jax.vjp(lambda p: embed_fn(p, inb), ep)
+            return pull(ct)[0]
+
+        def embed_skip(ep, inb, ct):
+            return jax.tree.map(jnp.zeros_like, ep)
+
+        dembed = jax.lax.cond(
+            is_first & valid_b, embed_branch, embed_skip,
+            params["embed"], in_b, dinp.astype(h_dtype))
+
+        grads = {
+            "embed": jax.tree.map(
+                lambda a, d: a + jnp.where(valid_b & is_first, d, 0),
+                grads["embed"], dembed),
+            "stage": jax.tree.map(
+                lambda a, d: a + jnp.where(valid_b, d, 0),
+                grads["stage"], dstage),
+            "head": jax.tree.map(
+                lambda a, d: a + jnp.where(valid_b, d, 0),
+                grads["head"], dhead),
+        }
+        loss_sum = loss_sum + loss_val    # zero off the last rank
         held_b = send_backward_recv_backward(dinp, axis_name)
 
         return (held_f, held_b, stash, grads, loss_sum), None

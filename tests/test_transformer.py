@@ -501,6 +501,45 @@ def test_pipeline_memory_discipline():
     ps.destroy_model_parallel()
 
 
+def test_pipelined_gpt_1f1b_memory_flat():
+    """The FULL-model 1F1B (real GPT blocks, embed + head in the scan)
+    keeps peak temp memory flat as n_microbatches grows 4 -> 16 —
+    nothing but the 2P-1-slot stash and the [nmb] integer inputs may
+    scale."""
+    from apex_tpu.models import GPTConfig
+    from apex_tpu.models.gpt_pipeline import PipelinedGPT
+
+    kw = dict(vocab_size=64, max_seq_len=32, hidden_size=32, num_layers=4,
+              num_heads=4, dtype=jnp.float32, attention_impl="fused_softmax")
+    ps.destroy_model_parallel()
+    mesh = ps.initialize_model_parallel(
+        pipeline_model_parallel_size_=2, devices=jax.devices()[:2])
+    pg = PipelinedGPT(GPTConfig(**kw), n_chunks=1)
+    mb, s = 2, 32
+
+    def temp_bytes(nmb):
+        rng = np.random.RandomState(5)
+        ids = jnp.asarray(rng.randint(0, 64, (nmb, mb, s)))
+        labels = jnp.asarray(rng.randint(0, 64, (nmb, mb, s)))
+
+        def inner(ids, labels):
+            params = pg.init(jax.random.PRNGKey(0), ids)
+            return pg.loss_and_grads_1f1b(params, ids, labels)
+        fn = jax.jit(shard_map(
+            inner, mesh=mesh, in_specs=(P(), P()),
+            out_specs=(P(), {"embed": P(), "chunks": P("pipeline"),
+                             "head": P()}),
+            check_vma=False))
+        ma = fn.lower(ids, labels).compile().memory_analysis()
+        return ma.temp_size_in_bytes
+
+    lo, hi = temp_bytes(4), temp_bytes(16)
+    mb_act = mb * s * 32 * 4   # one microbatch activation, fp32
+    assert hi - lo <= 4 * mb_act, (
+        f"full-model 1F1B temp memory grew {lo} -> {hi} over nmb 4 -> 16")
+    ps.destroy_model_parallel()
+
+
 def test_gpt_sequence_parallel_grads_match_plain_tp():
     """The SP backward path (reduce-scatter gather VJP + tensor-axis
     reduction of LN/bias partials) must reproduce plain-TP gradients.
@@ -685,6 +724,55 @@ def test_pipelined_gpt_grouped_matches_ungrouped():
             jax.tree_util.tree_flatten_with_path(g_g)[0]):
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=2e-4, atol=2e-5, err_msg=str(pa))
+    ps.destroy_model_parallel()
+
+
+def test_pipelined_gpt_1f1b_matches_interleaved_path():
+    """The FULL-model 1F1B schedule (embed grads via rank-0 cotangent
+    pullback, head grads + loss seed under the last-rank cond, the
+    2P-1-slot stash) must reproduce the grad-of-scan pipeline's loss
+    and every gradient on the real GPT at pp=2 x tp=2 (n_chunks=1),
+    with amp loss scaling."""
+    from apex_tpu.models import GPTConfig
+    from apex_tpu.models.gpt_pipeline import PipelinedGPT
+
+    kw = dict(vocab_size=64, max_seq_len=32, hidden_size=32, num_layers=4,
+              num_heads=4, dtype=jnp.float32, attention_impl="fused_softmax")
+    nmb, mb, s = 4, 2, 32
+    rng = np.random.RandomState(21)
+    ids = jnp.asarray(rng.randint(0, 64, (nmb, mb, s)))
+    labels = jnp.asarray(rng.randint(0, 64, (nmb, mb, s)))
+    scale = jnp.float32(256.0)
+
+    ps.destroy_model_parallel()
+    mesh = ps.initialize_model_parallel(
+        tensor_model_parallel_size_=2, pipeline_model_parallel_size_=2,
+        devices=jax.devices()[:4])
+    pg = PipelinedGPT(GPTConfig(**kw), n_chunks=1)
+
+    def run(which, ids, labels):
+        def inner(ids, labels):
+            params = pg.init(jax.random.PRNGKey(0), ids)
+            fn = (pg.loss_and_grads_1f1b if which == "1f1b"
+                  else pg.loss_and_grads)
+            loss, grads = fn(params, ids, labels, loss_scale=scale)
+            grads = jax.tree.map(lambda g: g / scale, grads)
+            return loss, grads
+        return jax.jit(shard_map(
+            inner, mesh=mesh, in_specs=(P(), P()),
+            out_specs=(P(), {"embed": P(), "chunks": P("pipeline"),
+                             "head": P()}),
+            check_vma=False))(ids, labels)
+
+    loss_ref, g_ref = run("interleaved", ids, labels)
+    loss_1f, g_1f = run("1f1b", ids, labels)
+    np.testing.assert_allclose(float(loss_1f), float(loss_ref), rtol=1e-5)
+    for (pa, a), (pb, b) in zip(
+            jax.tree_util.tree_flatten_with_path(g_ref)[0],
+            jax.tree_util.tree_flatten_with_path(g_1f)[0]):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=2e-4, atol=2e-5,
+            err_msg=str(pa))
     ps.destroy_model_parallel()
 
 
