@@ -457,20 +457,33 @@ def _gpt_step_setup(b, s, seed, **cfg_kw):
     return model, v, ids, step1
 
 
-def _bench_gpt_long_seq():
-    """GPT at s=4096 (b2): the long-context datapoint in the judged
-    artifact — flash attention past the fused-backward VMEM gate on the
-    two-kernel path, fused LM-head CE at 4x the bench token count per
-    row. Scanned at K=16 (the step is ~140 ms; 16 steps amortize the
-    dispatch overhead to ~7 ms/window)."""
-    b, s = 2, 4096
-    _, v, ids, step1 = _gpt_step_setup(b, s, seed=3)
-
-    k = 16
+def _time_gpt_variant(b, s, seed, k=16, **cfg_kw):
+    """Shared K-step timing for the GPT variant benches (long-seq, MoE):
+    returns (tokens_per_sec, step_s, iqr_s). K=16 suits the ~140-190 ms
+    steps of these shapes (dispatch overhead amortizes to ~7 ms/window).
+    """
+    _, v, ids, step1 = _gpt_step_setup(b, s, seed=seed, **cfg_kw)
     multi = _scanned(step1, k)
     times = _timed_windows(lambda: float(multi((v, ids))[1]))
     med, iqr = _median_iqr([t / k for t in times])
     return b * s / med, med, iqr
+
+
+def _bench_gpt_long_seq():
+    """GPT at s=4096 (b2): the long-context datapoint in the judged
+    artifact — flash attention past the fused-backward VMEM gate on the
+    two-kernel path, fused LM-head CE at 4x the bench token count per
+    row."""
+    return _time_gpt_variant(2, 4096, seed=3)
+
+
+def _bench_gpt_moe():
+    """GPT with every-other-block top-2 MoE (8 experts, dense mesh —
+    single-chip expert compute): the expert-parallel surface's
+    datapoint in the judged artifact. ~2x the MLP FLOPs of dense in the
+    MoE blocks plus routing."""
+    return _time_gpt_variant(8, 1024, seed=5, moe_num_experts=8,
+                             moe_every=2, moe_top_k=2)
 
 
 def _bench_bert():
@@ -561,6 +574,13 @@ def main():
             extras["gpt_s4096_step_iqr_ms"] = round(ls_iqr * 1e3, 3)
         except Exception as e:
             extras["gpt_s4096_error"] = f"{type(e).__name__}: {e}"[:120]
+        try:
+            moe_tps, moe_dt, moe_iqr = _bench_gpt_moe()
+            extras["gpt_moe_tokens_per_sec"] = round(moe_tps, 1)
+            extras["gpt_moe_step_ms"] = round(moe_dt * 1e3, 2)
+            extras["gpt_moe_step_iqr_ms"] = round(moe_iqr * 1e3, 3)
+        except Exception as e:
+            extras["gpt_moe_error"] = f"{type(e).__name__}: {e}"[:120]
         try:
             bert_tps, bert_mfu, bert_ops, bert_iqr, bert_disp = _bench_bert()
             extras["bert_tokens_per_sec"] = round(bert_tps, 1)
