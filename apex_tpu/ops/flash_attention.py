@@ -28,18 +28,25 @@ outside VMEM scratch.
 Shapes: q [b, h, sq, d]; k, v [b, h, sk, d]; segment_ids int32 [b, sq]
 ([b, sk] for kv if lengths differ). fp32 accumulation throughout.
 
-Default block sizes, tuned on a v5e chip (b8 h16 d64 bf16 fwd+bwd):
-1024 for non-causal shapes (256-blocks are ~1.9x slower — per-program
-overhead; 2048-blocks exceed VMEM). Causal shapes default to two
-512-aligned blocks per sequence (min two blocks lets the causal
-live-block skip drop the fully-future block pair: full-GPT step at
-s=1024 measured 93.4 ms with one 1024-block vs 92.8 ms with (512,512);
-s >= 2048 keeps 1024-blocks, which already skip). When bias AND dropout
-are both active the default drops to (512, 512): the extra
+Default block sizes, tuned on a v5e chip (b8 h16 d64 bf16): the forward
+and backward get INDEPENDENT defaults (r5 retune — the r3 single
+default conflated the two phases). Forward: 1024 everywhere (256-blocks
+are ~1.9x slower — per-program overhead; 2048-blocks exceed VMEM) —
+even causal, where one [1024, 1024] block per s=1024 sequence beats two
+512-blocks (1.33 vs 1.72 ms fwd-only) despite computing the fully-masked
+half: per-program overhead outweighs the live-block skip. Backward:
+causal s=1024 keeps two 512-aligned k blocks so the fused single-pass
+kernel applies (n_kb >= 2), measured 1.17 ms vs 1.29 ms fused-at-1024
+and 1.66 ms two-kernel; s >= 2048 uses 1024-blocks. When bias AND
+dropout are both active both defaults drop to (512, 512): the extra
 [block_q, block_k] fp32 bias block plus the keep mask push the 1024
 config over VMEM on hardware (verified at d=128 s=2048: bias-only ok,
 dropout-only ok, both fail). Blocks clamp to the sequence length for
-small shapes.
+small shapes. Per-pass VPU attribution at the GPT bench shape (measured
+r5, fwd): the two MXU dots + per-program overhead are 1.24 ms of the
+1.74 ms call; max-tracking 0.15 ms, exp 0.05 ms, causal mask+where
+0.02 ms, acc rescale 0.17 ms — i.e. the kernel is program-count bound,
+not exp-bound (exp costs the same as mul on the v5e VPU).
 """
 
 from __future__ import annotations
@@ -188,6 +195,39 @@ def _causal_block_live(qi, kb, block_q, block_k, causal_offset):
     return kb * block_k <= qi * block_q + (block_q - 1) + causal_offset
 
 
+def _causal_block_full(qi, kb, block_q, block_k, causal_offset):
+    """Whether block (qi, kb) is FULLY live under causal (no masked
+    entry): the last k position must be visible to the first q row.
+    Fully-live blocks skip mask construction entirely — the iota pair,
+    compare, and two where() passes are ~4 of the ~9 VPU passes over the
+    [block_q, block_k] tile, and for causal grids roughly half the live
+    blocks are full (s=1024 @ 512-blocks: 1 of 3; s=4096 @ 1024-blocks:
+    6 of 10), so this is the main VPU-time lever at d=64 (measured: exp
+    costs the same as mul on the v5e VPU — the kernel is pass-count
+    bound, not transcendental-bound)."""
+    return (kb + 1) * block_k - 1 <= qi * block_q + causal_offset
+
+
+def _dispatch_causal(compute, causal, use_segments, qi, kb, block_q,
+                     block_k, causal_offset):
+    """Run ``compute(masked: bool)`` under the right predication — shared
+    by all four kernels. Causal without segments splits live blocks into
+    fully-live (mask-free, see ``_causal_block_full``; bit-identical
+    since where(True, s, _) is the identity) and diagonal (mask built
+    and applied); causal with segments predicates on liveness only; all
+    other shapes run unconditionally, masked iff segments are present."""
+    if causal and not use_segments:
+        full = _causal_block_full(qi, kb, block_q, block_k, causal_offset)
+        live = _causal_block_live(qi, kb, block_q, block_k, causal_offset)
+        pl.when(full)(lambda: compute(False))
+        pl.when(live & jnp.logical_not(full))(lambda: compute(True))
+    elif causal:
+        live = _causal_block_live(qi, kb, block_q, block_k, causal_offset)
+        pl.when(live)(lambda: compute(True))
+    else:
+        compute(use_segments)
+
+
 # ---------------------------------------------------------------------------
 # Pallas forward kernel
 # ---------------------------------------------------------------------------
@@ -211,11 +251,7 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, use_segments,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    live = (_causal_block_live(qi, kb, block_q, block_k, causal_offset)
-            if causal else True)
-
-    @pl.when(live)
-    def _compute():
+    def _compute(masked):
         # operands stay in their native dtype: the MXU multiplies bf16
         # pairs exactly and accumulates fp32 (preferred_element_type), so
         # upcasting first changes nothing numerically but forces Mosaic's
@@ -229,8 +265,8 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, use_segments,
         if use_bias:
             s = s + bias_ref[0, 0].astype(jnp.float32)
 
-        mask = _block_mask(qi, kb, block_q, block_k, causal, causal_offset,
-                           sq_ref, skv_ref)
+        mask = (_block_mask(qi, kb, block_q, block_k, causal, causal_offset,
+                            sq_ref, skv_ref) if masked else None)
         if mask is not None:
             s = jnp.where(mask, s, _NEG_INF)
 
@@ -238,8 +274,16 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, use_segments,
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)
-        if mask is not None:
-            # guard fully-masked rows (padding): keep exp at 0
+        if mask is not None and (use_segments or use_bias
+                                 or causal_offset < 0):
+            # guard rows whose row max is the masked fill (m_new ==
+            # -1e30, so exp(s - m_new) = 1, not 0): segment padding
+            # rows, sq > sk rows with no visible k, or a -inf additive
+            # bias row pushing every live score below -1e30 can produce
+            # them — under plain causal with sq <= sk and no bias,
+            # k position 0 is live for every row from the first
+            # (kb == 0) block on, so m_new is finite and masked entries
+            # underflow to an exact 0 without the where() pass
             p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
@@ -256,6 +300,9 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, use_segments,
             preferred_element_type=jnp.float32)
         m_scr[:] = m_new
         l_scr[:] = l_new
+
+    _dispatch_causal(_compute, causal, use_segments, qi, kb, block_q,
+                     block_k, causal_offset)
 
     @pl.when(kb == n_kb - 1)
     def _finish():
@@ -397,11 +444,14 @@ def _flash_fwd_impl(q, k, v, segment_ids_q, segment_ids_kv, bias, seed,
 # Pallas backward kernels (flash-attention-2 decomposition)
 # ---------------------------------------------------------------------------
 
-def _recompute_p(q_ref, k_ref, lse_ref, bias_ref, mask, scale):
+def _recompute_p(q_ref, k_ref, lse_ref, bias_ref, mask, scale, guard):
     """p = exp(s - lse), zeroed where masked. [block_q, block_k].
-    ``mask=None`` = fully live (no padding can reach here, see
-    ``_block_mask``), so the where() guards — which also protect
-    padding rows whose lse is -1e30 — are safely skipped."""
+    ``mask=None`` = fully live (a non-masking shape, or a fully-live
+    causal block — see ``_causal_block_full``), so the where() passes are
+    skipped. ``guard``: whether rows with lse == -1e30 (segment padding)
+    or +inf blowups (sq > sk fully-masked rows) can exist — when False
+    (plain causal, sq <= sk) the post-exp where() is skipped too: masked
+    entries have s = -1e30 and finite lse, so exp underflows to exact 0."""
     q = q_ref[0, 0]                # native dtype: bf16 MXU path (see fwd)
     k = k_ref[0, 0]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -414,19 +464,28 @@ def _recompute_p(q_ref, k_ref, lse_ref, bias_ref, mask, scale):
     if mask is None:
         return jnp.exp(s - lse_col)
     s = jnp.where(mask, s, _NEG_INF)
-    return jnp.where(mask, jnp.exp(s - lse_col), 0.0)
+    p = jnp.exp(s - lse_col)
+    if guard:
+        p = jnp.where(mask, p, 0.0)
+    return p
 
 
 def _p_dp_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
              seed_ref, mask, scale, dropout_rate,
-             bi, hi, qi, kb, block_q, block_k):
+             bi, hi, qi, kb, block_q, block_k, guard):
     """Shared backward-block math: recompute p, form dp and ds.
 
     Returns ``(p_drop, do, ds)``. The dropout-backward rule lives ONLY
     here: ``ds`` multiplies the UNdropped ``p`` while ``dp`` is
     masked-and-rescaled, and ``p_drop`` (masked+rescaled) feeds dv.
+
+    NOTE: ``ds`` is returned UNSCALED — callers multiply the softmax
+    scale into the [*, d] dk/dq accumulators at their finish step
+    instead of paying a [block_q, block_k] multiply per block pair
+    (block_k/d = 8x fewer elements, and the fp32 post-dot multiply is
+    numerically at least as good as scaling ds before its bf16 cast).
     """
-    p = _recompute_p(q_ref, k_ref, lse_ref, bias_ref, mask, scale)
+    p = _recompute_p(q_ref, k_ref, lse_ref, bias_ref, mask, scale, guard)
     do = do_ref[0, 0]                                     # [block_q, d]
     dp = jax.lax.dot_general(
         do, v_ref[0, 0], (((1,), (1,)), ((), ())),
@@ -440,8 +499,6 @@ def _p_dp_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
     else:
         p_drop = p
     ds = p * (dp - delta_ref[0, 0, 0][:, None])
-    if scale != 1.0:
-        ds = ds * scale
     return p_drop, do, ds
 
 
@@ -458,35 +515,36 @@ def _dkdv_kernel(*refs, scale, causal, block_q, block_k, use_segments,
     bi, hi, kb, qi = (pl.program_id(0), pl.program_id(1),
                       pl.program_id(2), pl.program_id(3))
     n_qb = pl.num_programs(3)
+    guard = use_segments or use_bias or causal_offset < 0
 
     @pl.when(qi == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    live = (_causal_block_live(qi, kb, block_q, block_k, causal_offset)
-            if causal else True)
-
-    @pl.when(live)
-    def _compute():
-        mask = _block_mask(qi, kb, block_q, block_k, causal, causal_offset,
-                           sq_ref, skv_ref)
+    def _compute(masked):
+        mask = (_block_mask(qi, kb, block_q, block_k, causal, causal_offset,
+                            sq_ref, skv_ref) if masked else None)
         p_drop, do, ds = _p_dp_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
             seed_ref, mask, scale, dropout_rate, bi, hi, qi, kb,
-            block_q, block_k)
+            block_q, block_k, guard)
         # dv += p_drop^T @ do : [block_k, d]
         dv_scr[:] += jax.lax.dot_general(
             p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        # dk += ds^T @ q : [block_k, d]
+        # dk += ds^T @ q : [block_k, d] (softmax scale applied at finish)
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q_ref.dtype), q_ref[0, 0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
+    _dispatch_causal(_compute, causal, use_segments, qi, kb, block_q,
+                     block_k, causal_offset)
+
     @pl.when(qi == n_qb - 1)
     def _finish():
-        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dk = dk_scr[:] * scale if scale != 1.0 else dk_scr[:]
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
 
 
@@ -509,6 +567,7 @@ def _bwd_fused_kernel(*refs, scale, causal, block_q, block_k, use_segments,
     bi, hi, qi, kb = (pl.program_id(0), pl.program_id(1),
                       pl.program_id(2), pl.program_id(3))
     n_qb, n_kb = pl.num_programs(2), pl.num_programs(3)
+    guard = use_segments or use_bias or causal_offset < 0
 
     @pl.when((qi == 0) & (kb == 0))
     def _init_kv():
@@ -519,35 +578,40 @@ def _bwd_fused_kernel(*refs, scale, causal, block_q, block_k, use_segments,
     def _init_q():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    live = (_causal_block_live(qi, kb, block_q, block_k, causal_offset)
-            if causal else True)
-
-    @pl.when(live)
-    def _compute():
-        mask = _block_mask(qi, kb, block_q, block_k, causal, causal_offset,
-                           sq_ref, skv_ref)
+    def _compute(masked):
+        mask = (_block_mask(qi, kb, block_q, block_k, causal, causal_offset,
+                            sq_ref, skv_ref) if masked else None)
         p_drop, do, ds = _p_dp_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
             seed_ref, mask, scale, dropout_rate, bi, hi, qi, kb,
-            block_q, block_k)
+            block_q, block_k, guard)
         kv = pl.ds(kb * block_k, block_k)
         dv_scr[kv, :] += jax.lax.dot_general(
             p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        # ds rounds to the operand dtype ONCE and feeds both the dk and
+        # dq dots (q/k share a dtype on every real path); softmax scale
+        # applies at the [*, d] finish, not per [block_q, block_k] block
+        dsc = ds.astype(q_ref.dtype)
         dk_scr[kv, :] += jax.lax.dot_general(
-            ds.astype(q_ref.dtype), q_ref[0, 0], (((0,), (0,)), ((), ())),
+            dsc, q_ref[0, 0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dq_scr[...] += jax.lax.dot_general(
-            ds.astype(k_ref.dtype), k_ref[0, 0], (((1,), (0,)), ((), ())),
+            dsc.astype(k_ref.dtype), k_ref[0, 0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    _dispatch_causal(_compute, causal, use_segments, qi, kb, block_q,
+                     block_k, causal_offset)
 
     @pl.when(kb == n_kb - 1)
     def _finish_q():
-        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+        dq = dq_scr[...] * scale if scale != 1.0 else dq_scr[...]
+        dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
     @pl.when((qi == n_qb - 1) & (kb == n_kb - 1))
     def _finish_kv():
-        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dk = dk_scr[...] * scale if scale != 1.0 else dk_scr[...]
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
@@ -563,30 +627,31 @@ def _dq_kernel(*refs, scale, causal, block_q, block_k, use_segments,
     bi, hi, qi, kb = (pl.program_id(0), pl.program_id(1),
                       pl.program_id(2), pl.program_id(3))
     n_kb = pl.num_programs(3)
+    guard = use_segments or use_bias or causal_offset < 0
 
     @pl.when(kb == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    live = (_causal_block_live(qi, kb, block_q, block_k, causal_offset)
-            if causal else True)
-
-    @pl.when(live)
-    def _compute():
-        mask = _block_mask(qi, kb, block_q, block_k, causal, causal_offset,
-                           sq_ref, skv_ref)
+    def _compute(masked):
+        mask = (_block_mask(qi, kb, block_q, block_k, causal, causal_offset,
+                            sq_ref, skv_ref) if masked else None)
         _, _, ds = _p_dp_ds(
             q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
             seed_ref, mask, scale, dropout_rate, bi, hi, qi, kb,
-            block_q, block_k)
-        # dq += ds @ k : [block_q, d]
+            block_q, block_k, guard)
+        # dq += ds @ k : [block_q, d] (softmax scale applied at finish)
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0, 0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
+    _dispatch_causal(_compute, causal, use_segments, qi, kb, block_q,
+                     block_k, causal_offset)
+
     @pl.when(kb == n_kb - 1)
     def _finish():
-        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+        dq = dq_scr[:] * scale if scale != 1.0 else dq_scr[:]
+        dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
 def _flash_bwd_impl(res, do, *, scale, causal, dropout_rate, block_q,
@@ -753,12 +818,14 @@ def _bwd_math(res, do, *, scale, causal, dropout_rate=0.0):
 # Public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(7, 8, 9, 10, 11, 12, 13, 14))
 def _flash_attention(q, k, v, segment_ids_q, segment_ids_kv, bias, seed,
                      causal, scale, dropout_rate, block_q, block_k,
-                     interpret):
+                     block_q_bwd, block_k_bwd, interpret):
     out, _ = _fa_fwd(q, k, v, segment_ids_q, segment_ids_kv, bias, seed,
-                     causal, scale, dropout_rate, block_q, block_k, interpret)
+                     causal, scale, dropout_rate, block_q, block_k,
+                     block_q_bwd, block_k_bwd, interpret)
     return out
 
 
@@ -769,7 +836,7 @@ def _resolve_interpret(interpret):
 
 
 def _fa_fwd(q, k, v, sid_q, sid_kv, bias, seed, causal, scale, dropout_rate,
-            block_q, block_k, interpret):
+            block_q, block_k, block_q_bwd, block_k_bwd, interpret):
     scale_v = q.shape[-1] ** -0.5 if scale is None else scale
     out, lse = _flash_fwd_impl(q, k, v, sid_q, sid_kv, bias, seed, scale_v,
                                causal, dropout_rate, block_q, block_k,
@@ -777,14 +844,14 @@ def _fa_fwd(q, k, v, sid_q, sid_kv, bias, seed, causal, scale, dropout_rate,
     return out, (q, k, v, out, lse, sid_q, sid_kv, bias, seed)
 
 
-def _fa_bwd(causal, scale, dropout_rate, block_q, block_k, interpret,
-            res, do):
+def _fa_bwd(causal, scale, dropout_rate, block_q, block_k,
+            block_q_bwd, block_k_bwd, interpret, res, do):
     q = res[0]
     bias = res[7]
     scale_v = q.shape[-1] ** -0.5 if scale is None else scale
     dq, dk, dv = _flash_bwd_impl(
         res, do, scale=scale_v, causal=causal, dropout_rate=dropout_rate,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q_bwd, block_k=block_k_bwd,
         interpret=_resolve_interpret(interpret))
     # bias is an additive attention mask — non-differentiable by contract
     # (matches apex, where masks are inputs, never parameters); a real dbias
@@ -806,6 +873,8 @@ def flash_attention(q, k, v, segment_ids_q=None, segment_ids_kv=None,
                     dropout_seed=None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
+                    block_q_bwd: Optional[int] = None,
+                    block_k_bwd: Optional[int] = None,
                     interpret: Optional[bool] = None):
     """Fused attention. Returns [b, h, sq, d].
 
@@ -823,26 +892,45 @@ def flash_attention(q, k, v, segment_ids_q=None, segment_ids_kv=None,
     backward. ``dropout_seed`` is an int32 scalar (python int or array);
     pass a fresh value per training step. Ignored when
     ``dropout_rate == 0``.
+
+    ``block_q``/``block_k`` tile the FORWARD kernel;
+    ``block_q_bwd``/``block_k_bwd`` tile the backward kernels and default
+    to the phase-tuned values (module docstring) — or to the explicit
+    forward blocks when those are given, so existing callers see one
+    consistent tiling.
     """
     if dropout_rate >= 1.0 or dropout_rate < 0.0:
         raise ValueError(f"dropout_rate must be in [0, 1), got {dropout_rate}")
+    explicit_fwd_blocks = block_q is not None or block_k is not None
     if block_q is None or block_k is None:
         # bias + dropout together exceed VMEM at 1024 blocks (see module
-        # docstring); everything else is fastest at 1024
+        # docstring); everything else is fastest at 1024 in the FORWARD,
+        # including causal shapes: per-program overhead dominates the
+        # wasted fully-masked half of a [1024, 1024] diagonal block
+        # (measured b8 h16 s1024 d64 fwd-only: 1.33 ms @ (1024,1024) vs
+        # 1.72 ms @ (512,512) — the r3 two-block tuning conflated the
+        # forward with the backward, which has its own default below)
         default = 512 if (bias is not None and dropout_rate > 0.0) else 1024
-        if causal:
-            # two q/k blocks per sequence let the causal live-block skip
-            # drop one of the four block pairs (the fully-future one):
-            # measured full-GPT step s=1024 d=64, 93.39 -> 92.75 ms vs
-            # the single 1024 block. Smaller blocks lose more to
-            # per-program overhead than the skip saves ((256,256):
-            # 110.1 ms), hence the 512 floor; s >= 2048 already has
-            # multiple 1024-blocks to skip.
-            # rounded down to a 512 multiple: Pallas block dims must
-            # stay tile-aligned for any sq (e.g. sq=1100 -> 512)
-            default = min(default, max(512, (q.shape[2] // 2) // 512 * 512))
         block_q = block_q or default
         block_k = block_k or default
+    if block_q_bwd is None or block_k_bwd is None:
+        if explicit_fwd_blocks:
+            # back-compat: explicit caller blocks govern both phases
+            bq_d, bk_d = block_q, block_k
+        else:
+            bq_d = bk_d = 512 if (bias is not None and dropout_rate > 0.0) \
+                else 1024
+            if causal:
+                # the BACKWARD wants two 512-aligned k blocks per
+                # sequence at s=1024: that keeps the fused single-pass
+                # kernel (n_kb >= 2) with its per-(b,h) VMEM dk/dv
+                # accumulators, measured 1.17 ms vs 1.29 ms fused
+                # @ (1024,1024) and 1.66 ms two-kernel (b8 h16 d64);
+                # s >= 2048 keeps 1024 blocks (already multiple k blocks)
+                bq_d = bk_d = min(bq_d, max(512, (q.shape[2] // 2)
+                                            // 512 * 512))
+        block_q_bwd = block_q_bwd or bq_d
+        block_k_bwd = block_k_bwd or bk_d
     if dropout_rate > 0.0:
         if dropout_seed is None:
             raise ValueError("dropout_rate > 0 requires dropout_seed")
@@ -859,4 +947,5 @@ def flash_attention(q, k, v, segment_ids_q=None, segment_ids_kv=None,
                 f"{bias.shape}")
     return _flash_attention(q, k, v, segment_ids_q, segment_ids_kv, bias,
                             seed, causal, scale, float(dropout_rate),
-                            block_q, block_k, interpret)
+                            block_q, block_k, block_q_bwd, block_k_bwd,
+                            interpret)

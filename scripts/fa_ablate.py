@@ -1,0 +1,120 @@
+"""Attribute flash-attention kernel time: fwd-only vs fwd+bwd, and an
+in-kernel ablation of the fwd program (dots only / +max / +exp / full)
+at the bench GPT shape. All on-chip, scan-amortized."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def timed(g, x, k, windows=5):
+    float(g(x))
+    ts = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        float(g(x))
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[2] / k * 1e3  # ms/call
+
+
+def scan_over(fn, args, k=128):
+    @jax.jit
+    def g(args):
+        def body(c, _):
+            out = fn(*c)
+            # mix output back into q so nothing is DCE'd
+            return (c[0] + out.astype(c[0].dtype) * 1e-6,) + c[1:], ()
+        c, _ = jax.lax.scan(body, args, None, length=k)
+        return jnp.sum(c[0].astype(jnp.float32))
+    return g
+
+
+def fa_fwd_only(b=8, h=16, s=1024, d=64, k=128):
+    from apex_tpu.ops.flash_attention import flash_attention
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16) * 0.1
+    kk = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16) * 0.1
+    v = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16) * 0.1
+    f = lambda q, kk, v: flash_attention(q, kk, v, causal=True)
+    return timed(scan_over(f, (q, kk, v), k), (q, kk, v), k)
+
+
+def ablate_fwd(level, b=8, h=16, s=1024, d=64, bq=512, bk=512, k=128):
+    """level: dots | max | exp | mask | full"""
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16) * 0.1
+    kk = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16) * 0.1
+    v = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16) * 0.1
+    scale = d ** -0.5
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr):
+        qi, kb = pl.program_id(2), pl.program_id(3)
+        n_kb = pl.num_programs(3)
+
+        @pl.when(kb == 0)
+        def _():
+            m_scr[:] = jnp.full_like(m_scr, -1e30)
+            l_scr[:] = jnp.zeros_like(l_scr)
+            acc_scr[:] = jnp.zeros_like(acc_scr)
+
+        live = kb * bk <= qi * bq + (bq - 1)
+
+        @pl.when(live)
+        def _():
+            s_ = jax.lax.dot_general(q_ref[0, 0], k_ref[0, 0],
+                                     (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            s_ = s_ * scale
+            if level in ("mask", "full"):
+                q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+                k_pos = kb * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+                s_ = jnp.where(k_pos <= q_pos, s_, -1e30)
+            if level == "dots":
+                p = s_
+            elif level == "max":
+                m_new = jnp.maximum(m_scr[:], jnp.max(s_, axis=1, keepdims=True))
+                p = s_ - m_new
+                m_scr[:] = m_new
+            else:  # exp, mask, full
+                m_new = jnp.maximum(m_scr[:], jnp.max(s_, axis=1, keepdims=True))
+                p = jnp.exp(s_ - m_new)
+                alpha = jnp.exp(m_scr[:] - m_new)
+                l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+                m_scr[:] = m_new
+                if level == "full":
+                    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+                        p.astype(jnp.bfloat16), v_ref[0, 0],
+                        (((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+            if level != "full":
+                acc_scr[:] += jax.lax.dot_general(
+                    p.astype(jnp.bfloat16), v_ref[0, 0], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+
+        @pl.when(kb == n_kb - 1)
+        def _():
+            o_ref[0, 0] = acc_scr[:].astype(o_ref.dtype)
+
+    spec_q = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0))
+    spec_k = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, qi, ki: (b_, h_, ki, 0))
+    f = pl.pallas_call(
+        kernel,
+        grid=(b, h, s // bq, s // bk),
+        in_specs=[spec_q, spec_k, spec_k],
+        out_specs=spec_q,
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), jnp.bfloat16),
+        scratch_shapes=[pltpu.VMEM((bq, 1), jnp.float32),
+                        pltpu.VMEM((bq, 1), jnp.float32),
+                        pltpu.VMEM((bq, d), jnp.float32)],
+    )
+    return timed(scan_over(f, (q, kk, v), k), (q, kk, v), k)
+
+
+if __name__ == "__main__":
+    print("fwd-only (real kernel): %.3f ms" % fa_fwd_only())
+    for level in ["dots", "max", "exp", "mask", "full"]:
+        print("ablate %-5s : %.3f ms" % (level, ablate_fwd(level)))

@@ -1,0 +1,73 @@
+"""Probe per-element VPU cost of exp / exp2 / mul / where-chains in a
+VMEM-resident Pallas kernel (no HBM streaming: each program loops its
+compute REPS times over one resident block, so the measured time is pure
+VPU issue rate)."""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+REPS = 64
+BQ, BK = 512, 512
+
+
+def make_kernel(op):
+    def kernel(x_ref, o_ref):
+        acc = x_ref[...]
+        for _ in range(REPS):
+            if op == "exp":
+                acc = jnp.exp(acc * 1e-9)
+            elif op == "exp2":
+                acc = jnp.exp2(acc * 1e-9)
+            elif op == "mul":
+                acc = acc * 1.0000001
+            elif op == "max":
+                acc = jnp.maximum(acc, acc * 0.999999)
+            elif op == "where":
+                acc = jnp.where(acc > 0, acc, acc * 0.999)
+            elif op == "iota_cmp_where":
+                m = (jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 0)
+                     >= jax.lax.broadcasted_iota(jnp.int32, (BQ, BK), 1))
+                acc = jnp.where(m, acc, acc * 0.999)
+        o_ref[...] = acc
+    return kernel
+
+
+def probe(op, grid=64, scan_len=16):
+    x = jnp.asarray(np.random.randn(grid, BQ, BK), jnp.float32)
+    f = pl.pallas_call(
+        make_kernel(op),
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((1, BQ, BK), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((1, BQ, BK), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((grid, BQ, BK), jnp.float32),
+    )
+
+    @jax.jit
+    def g(x):
+        def body(c, _):
+            return f(c), ()
+        c, _ = jax.lax.scan(body, x, None, length=scan_len)
+        return jnp.sum(c)
+
+    float(g(x))
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(g(x))
+        times.append(time.perf_counter() - t0)
+    med = sorted(times)[2]
+    n = grid * REPS * BQ * BK * scan_len
+    per_elem_ns = med / n * 1e9
+    gelem = n / med / 1e9
+    print(f"{op:16s} {med*1e3:8.2f} ms   {per_elem_ns:7.4f} ns/elem "
+          f"({gelem:6.1f} Gelem/s)")
+
+
+if __name__ == "__main__":
+    for op in ["mul", "max", "where", "iota_cmp_where", "exp", "exp2"]:
+        probe(op)

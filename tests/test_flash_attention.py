@@ -180,6 +180,54 @@ def test_flash_bias_shape_validation():
         flash_attention(q, k, v, bias=jnp.zeros((2, 3, 16, 32)))
 
 
+def test_flash_causal_bias_neg_inf_row_no_future_leak():
+    """Regression (r5): a -1e30 additive-bias row under causal pushes
+    every LIVE score down to the causal fill value (-1e30 absorbs any
+    finite logit in fp32), so the row max equals the masked fill and
+    exp(s - m) = 1 on causally-masked entries unless the kernel keeps
+    its post-exp guard for bias shapes. The observable contract: the
+    degenerate row degrades to uniform attention over the VISIBLE
+    positions — its output must be completely insensitive to future
+    v rows (no causality leak), and stay finite."""
+    b, h, s, d = 1, 2, 64, 8
+    q, k, v = _qkv(b, h, s, s, d, seed=11)
+    rng = np.random.RandomState(12)
+    bias = jnp.asarray(rng.randn(1, 1, s, s) * 0.2, jnp.float32)
+    dead_row = 5
+    bias = bias.at[:, :, dead_row, :].set(-1e30)
+
+    def run(v):
+        return np.asarray(flash_attention(
+            q, k, v, bias=bias, causal=True, block_q=32, block_k=32)
+            .astype(jnp.float32))
+
+    out = run(v)
+    # perturb ONLY the future keys' values: the causal rows (incl. the
+    # degenerate one) must not move at all
+    v2 = v.at[:, :, dead_row + 1:].add(100.0)
+    out2 = run(v2)
+    np.testing.assert_array_equal(out[:, :, :dead_row + 1],
+                                  out2[:, :, :dead_row + 1])
+    # degenerate row = uniform average of the visible v rows
+    expect = np.asarray(jnp.mean(v[:, :, :dead_row + 1].astype(jnp.float32),
+                                 axis=2))
+    np.testing.assert_allclose(out[:, :, dead_row], expect,
+                               rtol=1e-4, atol=1e-5)
+    # the other rows still match the reference
+    ref = np.asarray(mha_reference(q, k, v, bias=bias, causal=True)
+                     .astype(jnp.float32))
+    live = [i for i in range(s) if i != dead_row]
+    np.testing.assert_allclose(out[:, :, live], ref[:, :, live],
+                               rtol=1e-4, atol=1e-5)
+    # gradients stay finite and dv gets no contribution from the future
+    # of the degenerate row beyond what live rows give it
+    g = jax.grad(lambda q, k, v: jnp.sum(jnp.tanh(
+        flash_attention(q, k, v, bias=bias, causal=True,
+                        block_q=32, block_k=32))), (0, 1, 2))(q, k, v)
+    for a in g:
+        assert np.isfinite(np.asarray(a.astype(jnp.float32))).all()
+
+
 # ---------------------------------------------------------------------------
 # In-kernel dropout: the keep mask is a counter-based hash of
 # (seed, b, h, q_pos, k_pos), so ``dropout_keep_reference`` regenerates
