@@ -66,6 +66,7 @@ from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.ops.lm_head_ce import fused_lm_head_cross_entropy
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.pipeline_parallel.schedules import (
+    forward_backward_pipelining_1f1b_interleaved_model,
     forward_backward_pipelining_1f1b_model, pipeline_apply_interleaved,
     staged_group_scan)
 from apex_tpu.transformer.tensor_parallel import (
@@ -370,26 +371,41 @@ class PipelinedGPT:
         if self.n_chunks != 1:
             raise ValueError(
                 f"1F1B is the non-interleaved schedule: n_chunks must be "
-                f"1, got {self.n_chunks}")
+                f"1, got {self.n_chunks} (use "
+                f"loss_and_grads_1f1b_interleaved)")
+        return self._loss_and_grads_1f1b_common(
+            params, ids_mb, labels_mb, loss_scale, interleaved=False)
+
+    def loss_and_grads_1f1b_interleaved(self, params, ids_mb, labels_mb,
+                                        loss_scale: Optional[jax.Array]
+                                        = None):
+        """Interleaved (vpp) 1F1B: virtual chunks AND flat activation
+        memory — Megatron's production schedule for the full GPT.
+
+        Same contract as ``loss_and_grads`` but through
+        ``forward_backward_pipelining_1f1b_interleaved_model``: peak
+        activation memory is the [V, 2P+1]-slot stash, constant in
+        ``n_microbatches``, with the single interleaved warmup/cooldown
+        bubble (no per-group bubbles — the advantage over
+        ``microbatch_group_size`` staged grads). Dense blocks only, no
+        sequence parallelism (same constraints as the plain 1F1B path).
+        """
+        return self._loss_and_grads_1f1b_common(
+            params, ids_mb, labels_mb, loss_scale, interleaved=True)
+
+    def _loss_and_grads_1f1b_common(self, params, ids_mb, labels_mb,
+                                    loss_scale, interleaved: bool):
         if self.has_moe:
-            raise ValueError("1F1B path does not carry the MoE aux "
+            raise ValueError("1F1B paths do not carry the MoE aux "
                              "channel; use loss_and_grads")
         if ps.sequence_parallel_active(self.cfg.sequence_parallel):
-            raise ValueError("1F1B path runs without sequence "
+            raise ValueError("1F1B paths run without sequence "
                              "parallelism; use loss_and_grads")
-        nmb, mb, s = ids_mb.shape
-        cfg = self.cfg
+        nmb = ids_mb.shape[0]
 
         def embed_fn(embed_params, inputs_mb):
             ids, _ = inputs_mb
             return self.embed.apply({"params": embed_params}, ids)
-
-        def stage_fn(stage_params, h):
-            # chunk leaves are [1, L, ...]: squeeze the chunk dim and
-            # reuse the interleaved path's stage body (dense guaranteed
-            # by the has_moe guard above)
-            return self.stage_fn(
-                jax.tree.map(lambda p: p[0], stage_params), h)
 
         def loss_fn(head_params, h, inputs_mb):
             _, labels = inputs_mb
@@ -402,9 +418,23 @@ class PipelinedGPT:
         sched_params = {"embed": params["embed"],
                         "stage": params["chunks"],
                         "head": params["head"]}
-        loss, g = forward_backward_pipelining_1f1b_model(
-            embed_fn, stage_fn, loss_fn, sched_params,
-            (ids_mb, labels_mb), nmb, self.axis_name)
+        if interleaved:
+            # chunk leaves are [V, L, ...]; the schedule indexes chunk c
+            # and hands stage_fn the [L, ...] slice it already scans
+            loss, g = forward_backward_pipelining_1f1b_interleaved_model(
+                embed_fn, self.stage_fn, loss_fn, sched_params,
+                (ids_mb, labels_mb), nmb, self.n_chunks, self.axis_name)
+        else:
+            def stage_fn(stage_params, h):
+                # chunk leaves are [1, L, ...]: squeeze the chunk dim and
+                # reuse the interleaved path's stage body (dense
+                # guaranteed by the has_moe guard above)
+                return self.stage_fn(
+                    jax.tree.map(lambda p: p[0], stage_params), h)
+
+            loss, g = forward_backward_pipelining_1f1b_model(
+                embed_fn, stage_fn, loss_fn, sched_params,
+                (ids_mb, labels_mb), nmb, self.axis_name)
         grads = {"embed": jax.lax.psum(g["embed"], self.axis_name),
                  "chunks": g["stage"],
                  "head": jax.lax.psum(g["head"], self.axis_name)}

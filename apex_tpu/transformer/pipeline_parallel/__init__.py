@@ -22,6 +22,8 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
     forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_1f1b,
     forward_backward_pipelining_1f1b_model,
+    forward_backward_pipelining_1f1b_interleaved,
+    forward_backward_pipelining_1f1b_interleaved_model,
     staged_group_scan,
     get_forward_backward_func,
 )

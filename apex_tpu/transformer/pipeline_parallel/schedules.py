@@ -115,6 +115,55 @@ def forward_backward_pipelining_without_interleaving(
     return loss, grads
 
 
+
+def _mb_slicer(inputs):
+    """Per-microbatch slicer over [n_microbatches, ...]-leaved ``inputs``."""
+    def slice_mb(m):
+        return jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, m, keepdims=False),
+            inputs)
+    return slice_mb
+
+
+def _probe_h(embed_fn, embed_params, slice_mb):
+    probe = jax.eval_shape(lambda p: embed_fn(p, slice_mb(0)), embed_params)
+    return probe.shape, probe.dtype
+
+
+def _head_seed(loss_fn, pred, head_params, out_b, in_b):
+    """Loss + head grads + backward seed under ``lax.cond(pred)`` — ONLY
+    the seeding rank pays for the head (its collectives are group-local
+    over the tensor axis, so other pp rows skipping is sound). Shared by
+    both 1F1B tick cores."""
+    def head_branch(hp, h, inb):
+        (loss, (dhp, dh)) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1))(hp, h, inb)
+        return loss, dhp, dh.astype(h.dtype)
+
+    def head_skip(hp, h, inb):
+        return (jnp.zeros((), jnp.float32),
+                jax.tree.map(jnp.zeros_like, hp),
+                jnp.zeros_like(h))
+
+    return jax.lax.cond(pred, head_branch, head_skip,
+                        head_params, out_b, in_b)
+
+
+def _embed_pullback(embed_fn, pred, embed_params, in_b, ct):
+    """Embedding cotangent pullback under ``lax.cond(pred)`` (rank 0's
+    input cotangent pulls back through ``embed_fn`` instead of falling
+    off the pipeline edge). Shared by both 1F1B tick cores."""
+    def embed_branch(ep, inb, c):
+        _, pull = jax.vjp(lambda p: embed_fn(p, inb), ep)
+        return pull(c)[0]
+
+    def embed_skip(ep, inb, c):
+        return jax.tree.map(jnp.zeros_like, ep)
+
+    return jax.lax.cond(pred, embed_branch, embed_skip,
+                        embed_params, in_b, ct)
+
+
 def forward_backward_pipelining_1f1b(
         stage_fn: Callable, loss_mb: Callable, stage_params, x,
         n_microbatches: int, axis_name: str = ps.PIPELINE_AXIS):
@@ -218,14 +267,9 @@ def forward_backward_pipelining_1f1b_model(
     total_ticks = n_microbatches + delay
     stash_slots = max(1, 2 * n_stages - 1)
 
-    def slice_mb(m):
-        return jax.tree.map(
-            lambda a: jax.lax.dynamic_index_in_dim(a, m, keepdims=False),
-            inputs)
+    slice_mb = _mb_slicer(inputs)
 
-    probe = jax.eval_shape(lambda p: embed_fn(p, slice_mb(0)),
-                           params["embed"])
-    h_shape, h_dtype = probe.shape, probe.dtype
+    h_shape, h_dtype = _probe_h(embed_fn, params["embed"], slice_mb)
 
     init = (
         jnp.zeros(h_shape, h_dtype),                      # held_f
@@ -260,33 +304,15 @@ def forward_backward_pipelining_1f1b_model(
             stash, m_bc % stash_slots, keepdims=False)
         out_b, pull_stage = jax.vjp(stage_fn, params["stage"], inp_b)
 
-        def head_branch(hp, h, inb):
-            (loss, (dhp, dh)) = jax.value_and_grad(
-                loss_fn, argnums=(0, 1))(hp, h, inb)
-            return loss, dhp, dh.astype(h.dtype)
-
-        def head_skip(hp, h, inb):
-            return (jnp.zeros((), jnp.float32),
-                    jax.tree.map(jnp.zeros_like, hp),
-                    jnp.zeros_like(h))
-
-        loss_val, dhead, seed = jax.lax.cond(
-            is_last & valid_b, head_branch, head_skip,
-            params["head"], out_b, in_b)
+        loss_val, dhead, seed = _head_seed(
+            loss_fn, is_last & valid_b, params["head"], out_b, in_b)
 
         g_out = jnp.where(is_last, seed, held_b)
         dstage, dinp = pull_stage(g_out)
 
-        def embed_branch(ep, inb, ct):
-            _, pull = jax.vjp(lambda p: embed_fn(p, inb), ep)
-            return pull(ct)[0]
-
-        def embed_skip(ep, inb, ct):
-            return jax.tree.map(jnp.zeros_like, ep)
-
-        dembed = jax.lax.cond(
-            is_first & valid_b, embed_branch, embed_skip,
-            params["embed"], in_b, dinp.astype(h_dtype))
+        dembed = _embed_pullback(
+            embed_fn, is_first & valid_b, params["embed"], in_b,
+            dinp.astype(h_dtype))
 
         grads = {
             "embed": jax.tree.map(
@@ -307,6 +333,192 @@ def forward_backward_pipelining_1f1b_model(
     (_, _, _, grads, loss_sum), _ = jax.lax.scan(
         tick, init, jnp.arange(total_ticks))
     return loss_sum, grads
+
+
+def forward_backward_pipelining_1f1b_interleaved_model(
+        embed_fn: Callable, stage_fn: Callable, loss_fn: Callable,
+        params, inputs, n_microbatches: int, n_chunks: int,
+        axis_name: str = ps.PIPELINE_AXIS):
+    """Interleaved (vpp) 1F1B: Megatron's production schedule — virtual
+    chunks AND flat activation memory — as one SPMD scan.
+
+    This closes the gap the staged-grads interleaved path
+    (``microbatch_group_size``) leaves open: that path bounds memory by
+    paying one extra (P-1)-tick bubble per group, while this schedule
+    keeps the single warmup/cooldown bubble and a stash that is constant
+    in ``n_microbatches``. It is the schedule the reference's vpp rank
+    state exists to serve (``apex/transformer/parallel_state.py:252-322``
+    tracks virtual ranks precisely so Megatron's interleaved 1F1B can
+    place chunk ``c`` of rank ``r`` at global stage ``g = c*P + r``).
+
+    Timeline (D = V*P global stages; B(m) = (m//P)*V*P):
+
+    - forward of (microbatch m, global stage g) at tick
+      ``t_f = B(m) + (m%P) + g`` — the same enumeration as
+      ``pipeline_apply_interleaved`` (unit ``u = t - rank``);
+    - backward of (m, g) at tick ``t_b = B(m) + (m%P) + 2(D-1) - g`` —
+      the exact time-reversal, so on the last global stage the backward
+      runs in the same tick as the forward (1F1B's defining property)
+      and each cotangent is consumed exactly one tick after it is
+      produced by the next-lower global stage.
+
+    Per-rank backward inversion: with ``w = t - 2(D-1) + rank``,
+    ``l = w mod P``, ``z = (w - l)/P`` (= qV - c), ``q = ceil(z/V)``:
+    chunk ``c_b = q*V - z`` decreasing within each group (chunk V-1
+    first), microbatch ``m_b = q*P + l``. Both transports are one
+    wrapped ring ``ppermute`` per tick: forward rank P-1 -> 0 feeds the
+    next chunk; backward rank 0 -> P-1 feeds the previous chunk (the
+    wrapped value landing on the last global stage is overridden by the
+    loss-head seed, and rank 0's chunk-0 cotangent pulls back through
+    ``embed_fn`` instead of riding the wrap).
+
+    Stash: ``[V, 2P+1]`` slots per rank (slot ``m mod (2P+1)`` of chunk
+    ``c``) — at the worst stage (g=0) at most 2P chunk-c forwards fit in
+    the ``2(D-1)``-tick forward->backward span, so 2P+1 slots can never
+    collide; peak activation memory is O(V·P·mb), CONSTANT in
+    ``n_microbatches`` (asserted by
+    ``test_pipeline_interleaved_1f1b_memory_flat``).
+
+    Same contracts as ``forward_backward_pipelining_1f1b_model``:
+    ``params`` = {embed, stage, head} with ``stage`` leaves stacked
+    [n_chunks, ...]; returns ``(loss_sum, grads)`` with embed/head grads
+    on their owning ranks — psum over the pipeline axis. Requires
+    ``n_microbatches % P == 0`` (the Megatron interleaving constraint).
+    """
+    n_microbatches = resolve_num_microbatches(n_microbatches)
+    n_stages = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    V = n_chunks
+    P = n_stages
+    D = V * P
+    lead = {leaf.shape[0]
+            for leaf in jax.tree_util.tree_leaves(params["stage"])}
+    if lead != {V}:
+        raise ValueError(
+            f"params['stage'] leaves must be stacked [n_chunks={V}, ...]; "
+            f"got leading dims {sorted(lead)}")
+    if n_microbatches % n_stages != 0:
+        raise ValueError(
+            f"interleaved 1F1B needs n_microbatches ({n_microbatches}) "
+            f"divisible by pipeline size ({n_stages})")
+    is_last = rank == n_stages - 1
+    is_first = rank == 0
+    # last backward: microbatch nmb-1 at global stage 0
+    total_ticks = ((n_microbatches - 1) // P) * D + (n_microbatches - 1) % P \
+        + 2 * (D - 1) + 1
+    stash_slots = 2 * P + 1
+
+    slice_mb = _mb_slicer(inputs)
+
+    def chunk_of(tree, c):
+        return jax.tree.map(
+            lambda p: jax.lax.dynamic_index_in_dim(p, c, 0, keepdims=False),
+            tree)
+
+    h_shape, h_dtype = _probe_h(embed_fn, params["embed"], slice_mb)
+
+    init = (
+        jnp.zeros(h_shape, h_dtype),                          # held_f
+        jnp.zeros(h_shape, h_dtype),                          # held_b
+        jnp.zeros((V, stash_slots) + h_shape, h_dtype),       # input stash
+        jax.tree.map(jnp.zeros_like, params),                 # grad acc
+        jnp.zeros((), jnp.float32),                           # loss sum
+    )
+
+    def tick(carry, i):
+        held_f, held_b, stash, grads, loss_sum = carry
+
+        # -- forward unit (same enumeration as the fill-drain schedule) --
+        u = i - rank
+        valid_f = (u >= 0) & (u < V * n_microbatches)
+        uc = jnp.clip(u, 0, V * n_microbatches - 1)
+        grp, rem = uc // D, uc % D
+        c_f = rem // P
+        m_f = grp * P + rem % P
+        pf = chunk_of(params["stage"], c_f)
+        inject = embed_fn(params["embed"], slice_mb(m_f))
+        inp = jnp.where(valid_f & (c_f == 0) & is_first, inject, held_f)
+        out = stage_fn(pf, inp)
+        slot = m_f % stash_slots
+        cur = jax.lax.dynamic_index_in_dim(
+            jax.lax.dynamic_index_in_dim(stash, c_f, 0, keepdims=False),
+            slot, 0, keepdims=False)
+        new_slot = jnp.where(valid_f, inp, cur)
+        stash = jax.lax.dynamic_update_slice(
+            stash, new_slot[None, None], (c_f, slot) + (0,) * len(h_shape))
+        held_f = ring_shift(out, axis_name, wrap=True)
+
+        # -- backward unit (time-reversed enumeration) -------------------
+        w = i - 2 * (D - 1) + rank
+        l = w % P                                    # nonneg (floor mod)
+        z = (w - l) // P                             # = q*V - c_b
+        q = (z + V - 1) // V                         # ceil(z / V)
+        c_b = q * V - z
+        m_b = q * P + l
+        valid_b = (q >= 0) & (m_b < n_microbatches)
+        m_bc = jnp.clip(m_b, 0, n_microbatches - 1)
+        c_bc = jnp.clip(c_b, 0, V - 1)
+        in_b = slice_mb(m_bc)
+        inp_b = jax.lax.dynamic_index_in_dim(
+            jax.lax.dynamic_index_in_dim(stash, c_bc, 0, keepdims=False),
+            m_bc % stash_slots, 0, keepdims=False)
+        pb = chunk_of(params["stage"], c_bc)
+        out_b, pull_stage = jax.vjp(stage_fn, pb, inp_b)
+
+        seed_here = is_last & valid_b & (c_bc == V - 1)
+        loss_val, dhead, seed = _head_seed(
+            loss_fn, seed_here, params["head"], out_b, in_b)
+
+        g_out = jnp.where(seed_here, seed, held_b)
+        dchunk, dinp = pull_stage(g_out)
+
+        dembed = _embed_pullback(
+            embed_fn, is_first & valid_b & (c_bc == 0), params["embed"],
+            in_b, dinp.astype(h_dtype))
+
+        def scatter_chunk(acc, d):
+            cur_c = jax.lax.dynamic_index_in_dim(acc, c_bc, 0,
+                                                 keepdims=False)
+            upd = cur_c + jnp.where(valid_b, d, 0)
+            return jax.lax.dynamic_update_index_in_dim(acc, upd, c_bc, 0)
+
+        grads = {
+            "embed": jax.tree.map(
+                lambda a, d: a + jnp.where(valid_b & is_first, d, 0),
+                grads["embed"], dembed),
+            "stage": jax.tree.map(scatter_chunk, grads["stage"], dchunk),
+            "head": jax.tree.map(
+                lambda a, d: a + jnp.where(valid_b, d, 0),
+                grads["head"], dhead),
+        }
+        loss_sum = loss_sum + loss_val        # zero off the seeding rank
+        held_b = ring_shift(dinp, axis_name, reverse=True, wrap=True)
+
+        return (held_f, held_b, stash, grads, loss_sum), None
+
+    (_, _, _, grads, loss_sum), _ = jax.lax.scan(
+        tick, init, jnp.arange(total_ticks))
+    return loss_sum, grads
+
+
+def forward_backward_pipelining_1f1b_interleaved(
+        stage_fn: Callable, loss_mb: Callable, chunk_params, x,
+        n_microbatches: int, n_chunks: Optional[int] = None,
+        axis_name: str = ps.PIPELINE_AXIS):
+    """Headless interleaved 1F1B (stage stack only) — the vpp analog of
+    ``forward_backward_pipelining_1f1b``. ``chunk_params`` leaves stacked
+    [n_chunks, ...]; ``loss_mb(out) -> scalar`` per microbatch on the
+    last rank's LAST chunk. Returns (loss_sum, chunk grads)."""
+    if n_chunks is None:
+        leaf = jax.tree_util.tree_leaves(chunk_params)[0]
+        n_chunks = leaf.shape[0]
+    loss, grads = forward_backward_pipelining_1f1b_interleaved_model(
+        lambda _, x_mb: x_mb,
+        stage_fn,
+        lambda _, h, __: loss_mb(h),
+        {"embed": {}, "stage": chunk_params, "head": {}},
+        x, n_microbatches, n_chunks, axis_name)
+    return loss, grads["stage"]
 
 
 def staged_group_scan(grad_of_group: Callable, params, xs,

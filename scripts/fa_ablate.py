@@ -1,7 +1,6 @@
 """Attribute flash-attention kernel time: fwd-only vs fwd+bwd, and an
 in-kernel ablation of the fwd program (dots only / +max / +exp / full)
 at the bench GPT shape. All on-chip, scan-amortized."""
-import functools
 import time
 
 import jax

@@ -4,7 +4,6 @@ Times the attention custom-vjp alone (value_and_grad of sum(out)) over a
 scanned loop, so per-dispatch overhead amortizes.  Used for the round-5
 VPU-time experiments (asymmetric blocks, exp2, mask-free full blocks).
 """
-import functools
 import time
 
 import jax

@@ -2,7 +2,6 @@
 VMEM-resident Pallas kernel (no HBM streaming: each program loops its
 compute REPS times over one resident block, so the measured time is pure
 VPU issue rate)."""
-import functools
 import time
 
 import jax
