@@ -53,14 +53,18 @@ class BertConfig:
     sequence_parallel: bool = False
     # ``loss`` can fuse the tied LM-head matmul into the cross entropy
     # (``ops.lm_head_ce``; no [b, s, V] logits in HBM). Default False
-    # for BERT by measurement: at BERT-base shape (V=30k, h=768,
-    # 16k tokens) the backward's logit-tile recompute (~3.9 ms of extra
-    # matmul) exceeds what the fusion saves — v5e full-step 128.6 ms
-    # unfused vs 130.4 ms best-tuned fused (re-confirmed r4 under the
-    # 64 MB kernel budget: 121.3 unfused vs 123.1-126.1 fused). Flip it
-    # on for large-vocab variants, where the saved [tokens, V] round
-    # trips dominate (GPT at V=32k/h=1024 measures the other way; see
-    # GPTConfig).
+    # for BERT by measurement, root-caused r5 (docs/perf.md): the fused
+    # backward pays a 4th full n·V·h dot (logit-tile recompute) while
+    # the [n, V] bf16 logits traffic it saves is smaller and largely
+    # hidden by XLA's scheduler — standalone at BERT-base shape the
+    # fused kernel measures 20.8 ms vs 16.5-17.7 unfused (full step
+    # r4: 121.3 unfused vs 123.1-126.1 fused). The attend dots already
+    # run above step-average MXU efficiency (14.3% of step FLOPs in
+    # 11.4% of step time), so this is structural, not tuning. Flip it
+    # on for large-vocab / long-seq variants where the O(tokens + V)
+    # memory bound is the point (GPT at V=32k/h=1024 measures the
+    # other way at the FULL-STEP level — a whole-program residency
+    # effect; see GPTConfig).
     fused_lm_head: bool = False
 
     @property

@@ -35,9 +35,10 @@ are ~1.9x slower — per-program overhead; 2048-blocks exceed VMEM) —
 even causal, where one [1024, 1024] block per s=1024 sequence beats two
 512-blocks (1.33 vs 1.72 ms fwd-only) despite computing the fully-masked
 half: per-program overhead outweighs the live-block skip. Backward:
-causal s=1024 keeps two 512-aligned k blocks so the fused single-pass
-kernel applies (n_kb >= 2), measured 1.17 ms vs 1.29 ms fused-at-1024
-and 1.66 ms two-kernel; s >= 2048 uses 1024-blocks. When bias AND
+causal s=1024 keeps two 512-aligned k blocks — measured 1.17 ms vs
+1.29 ms fused-at-1024 and 1.66 ms two-kernel (the fused single-pass
+kernel runs at any n_kb since r5; the 512 choice is purely the faster
+measurement); s >= 2048 uses 1024-blocks. When bias AND
 dropout are both active both defaults drop to (512, 512): the extra
 [block_q, block_k] fp32 bias block plus the keep mask push the 1024
 config over VMEM on hardware (verified at d=128 s=2048: bias-only ok,
@@ -64,15 +65,16 @@ _NEG_INF = -1e30
 # Fused single-pass backward runs while its per-(b,h) dk/dv accumulators
 # (2x [sk, d] fp32 scratch + the dk/dv output blocks in their own dtype)
 # leave room under Mosaic's 16 MB scoped-VMEM limit next to
-# the ~10 MB of block operands and p/ds transients; beyond it (and for
-# single-k-block shapes, where it measured slightly slower than the
-# two-kernel form on a v5e) the two-kernel flash-attention-2
-# decomposition takes over (~2x the p-recompute and q/k/v/do reads, but
-# O(block) VMEM). Measured v5e b4 h16 d64 s2048 causal bf16 fwd+bwd:
-# 8.6 ms fused vs 9.7 ms two-kernel. The gate also counts bias/dropout
-# block bytes; a bias-active shape that passes it (bf16 d64 s2048 at
-# 256-blocks: 1.84 MB) was verified on hardware — compiles under the
-# Mosaic scoped-VMEM limit and matches the reference backward.
+# the ~10 MB of block operands and p/ds transients; beyond it the
+# two-kernel flash-attention-2 decomposition takes over (~2x the
+# p-recompute and q/k/v/do reads, but O(block) VMEM). Measured v5e
+# b4 h16 d64 s2048 causal bf16 fwd+bwd: 8.6 ms fused vs 9.7 ms
+# two-kernel; single-k-block shapes ALSO run fused since the r5
+# deferred-scale/ds-reuse kernel (b32 h12 s512 d64: 3.43 -> 3.16 ms —
+# the r3 n_kb >= 2 gate no longer held). The gate also counts
+# bias/dropout block bytes; a bias-active shape that passes it (bf16
+# d64 s2048 at 256-blocks: 1.84 MB) was verified on hardware — compiles
+# under the Mosaic scoped-VMEM limit and matches the reference backward.
 _FUSED_BWD_MAX_KV_BYTES = 2 * 1024 * 1024
 
 
@@ -711,10 +713,13 @@ def _flash_bwd_impl(res, do, *, scale, causal, dropout_rate, block_q,
         return pl.BlockSpec((1, 1, 1, block_q),
                             lambda *g, _q=qdim: (g[0], g[1], 0, g[_q]))
 
-    # --- fused single-pass backward when k is actually streamed
-    # (n_kb >= 2 — the single-block case measured slower fused) and the
-    # [sk, d] dk/dv accumulators fit the scoped-VMEM budget (fp32 scratch
-    # pair + the dk/dv output blocks in their own dtype)
+    # --- fused single-pass backward when the [sk, d] dk/dv accumulators
+    # fit the scoped-VMEM budget (fp32 scratch pair + the dk/dv output
+    # blocks in their own dtype). r5 re-measure: the old n_kb >= 2 gate
+    # (single-block fused had measured slightly slower in r3) no longer
+    # holds with the deferred-scale/ds-reuse kernel — fused wins at every
+    # single-k-block shape tried (b32 h12 s512 d64: 3.43 -> 3.16 ms;
+    # b8 h16 s512 d64: 1.61 -> 1.25; b4 h16 s512 d128: 0.93 -> 0.91)
     kv_bytes = sk_p * d * (8 + k.dtype.itemsize + v.dtype.itemsize)
     # bias rides as an extra [block_q, block_k] fp32 operand block and
     # dropout regenerates a same-shape keep mask in VMEM; the 2 MB cap
@@ -725,7 +730,7 @@ def _flash_bwd_impl(res, do, *, scale, causal, dropout_rate, block_q,
         kv_bytes += 4 * block_q * block_k
     if dropout_rate > 0.0:
         kv_bytes += 4 * block_q * block_k
-    if n_kb >= 2 and kv_bytes <= _FUSED_BWD_MAX_KV_BYTES:
+    if kv_bytes <= _FUSED_BWD_MAX_KV_BYTES:
         especs, eops = extra(qdim=2, kdim=3)
         kvspec = pl.BlockSpec((1, 1, sk_p, d), lambda *g: (g[0], g[1], 0, 0))
         dq, dk, dv = pl.pallas_call(
@@ -922,11 +927,10 @@ def flash_attention(q, k, v, segment_ids_q=None, segment_ids_kv=None,
                 else 1024
             if causal:
                 # the BACKWARD wants two 512-aligned k blocks per
-                # sequence at s=1024: that keeps the fused single-pass
-                # kernel (n_kb >= 2) with its per-(b,h) VMEM dk/dv
-                # accumulators, measured 1.17 ms vs 1.29 ms fused
-                # @ (1024,1024) and 1.66 ms two-kernel (b8 h16 d64);
-                # s >= 2048 keeps 1024 blocks (already multiple k blocks)
+                # sequence at s=1024: measured 1.17 ms vs 1.29 ms fused
+                # @ (1024,1024) and 1.66 ms two-kernel (b8 h16 d64) —
+                # the fused kernel runs at any n_kb (r5), this is purely
+                # the faster tiling; s >= 2048 keeps 1024 blocks
                 bq_d = bk_d = min(bq_d, max(512, (q.shape[2] // 2)
                                             // 512 * 512))
         block_q_bwd = block_q_bwd or bq_d
