@@ -11,6 +11,10 @@ import jax
 class AmpState:
     def __init__(self):
         self.hard_override = False
+        # amp.initialize(enabled=False) flips this; scale_loss consults
+        # it (with the empty-loss_scalers fallback) to pass the loss
+        # through unscaled (apex/amp/frontend.py:198,209)
+        self.enabled = True
         self.allow_incoming_model_not_fp32 = False
         self.verbosity = 1
         self.handle = None

@@ -143,11 +143,13 @@ class _AmpStash:
 def initialize(
     models,
     optimizers=None,
+    enabled: bool = True,
     opt_level: str = "O1",
     *,
     half_dtype=None,
     cast_model_type=None,
     cast_ops=None,
+    patch_torch_functions=None,
     keep_batchnorm_fp32=None,
     master_weights=None,
     loss_scale=None,
@@ -166,8 +168,45 @@ def initialize(
 
     Returns ``(models, optimizers)`` with the same list-ness as the inputs
     (frontend.py:342-358).
+
+    ``enabled=False`` renders amp inert (``apex/amp/frontend.py:195-215``):
+    no casting, no scaler arming, and ``amp.scale_loss`` yields the loss
+    unscaled — code written against the amp API runs at full precision
+    with zero overhead. Models come back with the SAME calling
+    convention as the enabled path (``fn(params, *args)``): a flax
+    Module input returns its ``.apply`` rather than the unbound module,
+    so ``m = initialize(module, ..., enabled=flag)`` is callable either
+    way. Optimizers are returned untouched. ``enabled`` sits third
+    positionally, exactly like the reference.
     """
     _amp_state.verbosity = verbosity
+    if isinstance(enabled, str):
+        # someone ported OUR pre-r5 positional order (opt_level third)
+        raise TypeError(
+            f"initialize() got {enabled!r} for 'enabled' (3rd positional "
+            f"arg, matching apex). Pass opt_level as a keyword: "
+            f"initialize(models, optimizers, opt_level={enabled!r})")
+    if not enabled:
+        _amp_state.enabled = False
+        _amp_state.opt_properties = None
+        _amp_state.loss_scalers = []
+        maybe_print("amp disabled (enabled=False): pass-through", True)
+
+        def _plain(m):
+            return m.apply if hasattr(m, "apply") else m
+        if isinstance(models, (list, tuple)):
+            out_models = type(models)(_plain(m) for m in models)
+        else:
+            out_models = _plain(models)
+        if optimizers is None:
+            return out_models
+        return out_models, optimizers
+    _amp_state.enabled = True
+    if patch_torch_functions is not None and cast_ops is None:
+        # the reference's O1 knob name (apex/amp/frontend.py:201): there
+        # is no torch namespace to patch on TPU — the equivalent scope
+        # is the op-registry autocast, i.e. cast_ops
+        cast_ops = patch_torch_functions
     if opt_level not in opt_levels:
         raise RuntimeError(f"Unexpected optimization level {opt_level}. Options are 'O0', 'O1', 'O2', 'O3'.")
 
@@ -257,14 +296,17 @@ def load_master_state_dict(optimizer, opt_state, fp32_params):
     return optimizer.restore_master(opt_state, fp32_params)
 
 
-def state_dict() -> dict:
-    d = {}
+def state_dict(destination: dict | None = None) -> dict:
+    """``destination`` fills a caller-supplied dict, like the reference
+    (``apex/amp/frontend.py:361-372``)."""
+    d = {} if destination is None else destination
     for i, s in enumerate(_amp_state.loss_scalers):
         d[f"loss_scaler{i}"] = s.state_dict()
     return d
 
 
-def load_state_dict(sd: dict):
+def load_state_dict(state_dict: dict):
+    sd = state_dict
     if len(sd) != len(_amp_state.loss_scalers):
         maybe_print(
             f"Warning: state_dict has {len(sd)} entries but amp has "

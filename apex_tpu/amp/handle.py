@@ -29,14 +29,21 @@ from apex_tpu.amp._amp_state import _amp_state, maybe_print
 
 
 @contextlib.contextmanager
-def scale_loss(loss, optimizers, loss_id: int = 0, model=None, delay_unscale: bool = False):
+def scale_loss(loss, optimizers, loss_id: int = 0, model=None,
+               delay_unscale: bool = False,
+               delay_overflow_check: bool = False):
     """Yield the scaled loss; on exit update the scaler from observed state.
 
     ``delay_unscale`` mirrors ``apex/amp/handle.py:67-79`` (gradient
     accumulation: skip unscale/update this iteration).
+    ``delay_overflow_check`` (``apex/amp/handle.py:80-84``) exists for
+    signature parity: it deferred the CUDA-stream overflow readback; the
+    TPU scaler's overflow check is already a device-side ``lax.cond``
+    with no host sync to defer, so the flag is accepted and inert.
     """
-    if not _amp_state.loss_scalers:
-        # amp not initialized → passthrough, like handle.py:21-29
+    if not _amp_state.enabled or not _amp_state.loss_scalers:
+        # amp disabled (initialize(enabled=False)) or not initialized →
+        # passthrough, like handle.py:21-29
         yield loss
         return
 

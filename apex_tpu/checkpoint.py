@@ -135,6 +135,14 @@ def save_checkpoint_orbax(path: str, tree: Any, *,
 
     path = os.path.abspath(path)
     if not async_save:
+        if checkpointer is not None:
+            # a caller-supplied AsyncCheckpointer would be silently
+            # ignored here, leaving them an open checkpointer they
+            # believe is being reused (advisor r4)
+            raise ValueError(
+                "checkpointer= is only meaningful with async_save=True; "
+                "close your AsyncCheckpointer (or keep async_save=True) "
+                "instead of passing it to a sync save")
         with ocp.StandardCheckpointer() as ckptr:
             ckptr.save(path, tree, force=True)
         return None

@@ -93,6 +93,7 @@ class DistributedDataParallel:
                  allreduce_communicators=None,
                  gradient_average: bool = True,
                  gradient_predivide_factor: float = 1.0,
+                 gradient_average_split_factor=None,
                  prof: bool = False):
         self.module = module
         self.axis_name = axis_name
@@ -111,6 +112,12 @@ class DistributedDataParallel:
             inert.append(f"num_allreduce_streams={num_allreduce_streams}")
         if allreduce_communicators is not None:
             inert.append("allreduce_communicators")
+        if gradient_average_split_factor is not None:
+            # legacy knob (apex/parallel/distributed.py): split the
+            # average across the two allreduce halves — no split halves
+            # exist here, psum + one scale is exact
+            inert.append("gradient_average_split_factor="
+                         f"{gradient_average_split_factor}")
         if inert:
             _warn_inert_once(
                 "DistributedDataParallel: "

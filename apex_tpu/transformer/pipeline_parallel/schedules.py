@@ -149,6 +149,24 @@ def _head_seed(loss_fn, pred, head_params, out_b, in_b):
                         head_params, out_b, in_b)
 
 
+def _embed_inject(embed_fn, pred, embed_params, in_mb, h_shape, h_dtype):
+    """Injection embed under ``lax.cond(pred)``: only the rank that will
+    actually consume the injection pays the embed compute (advisor r4 —
+    previously every rank embedded every tick, nmb + 2(P-1) times per
+    rank vs nmb total useful; measurable for large-vocab
+    VocabParallelEmbedding). Sound for the same reason as the head/
+    embed-pullback conds: embed collectives span the TENSOR axis within
+    one pp row, and the predicate is uniform across that row, so pp
+    rows that skip are not party to the collective."""
+    def do(ep, mb):
+        return embed_fn(ep, mb).astype(h_dtype)
+
+    def skip(ep, mb):
+        return jnp.zeros(h_shape, h_dtype)
+
+    return jax.lax.cond(pred, do, skip, embed_params, in_mb)
+
+
 def _embed_pullback(embed_fn, pred, embed_params, in_b, ct):
     """Embedding cotangent pullback under ``lax.cond(pred)`` (rank 0's
     input cotangent pulls back through ``embed_fn`` instead of falling
@@ -232,10 +250,12 @@ def forward_backward_pipelining_1f1b_model(
     tick schedule with:
 
     - ``embed_fn(params['embed'], inputs_mb) -> h``: computes the
-      injection for microbatch ``m`` (consumed on rank 0; every rank
-      computes it — embeddings are cheap and any collectives inside,
-      e.g. VocabParallelEmbedding's tensor-axis psum, stay collectively
-      consistent across the mesh this way).
+      injection for microbatch ``m``, under ``lax.cond`` so only rank 0
+      pays for it (advisor r4 — see ``_embed_inject``; sound because
+      embed collectives, e.g. VocabParallelEmbedding's tensor-axis
+      psum, are group-local to one pp row and the predicate is uniform
+      across that row; embed_fn must not carry pipeline-axis
+      collectives, which nothing in the repo does).
     - ``loss_fn(params['head'], h_out, inputs_mb) -> scalar``: the loss
       head for one microbatch, run under ``lax.cond`` so ONLY the last
       pipeline rank pays for it (at tp>1 its collectives span the
@@ -286,8 +306,10 @@ def forward_backward_pipelining_1f1b_model(
         m_f = i - rank
         valid_f = (m_f >= 0) & (m_f < n_microbatches)
         m_fc = jnp.clip(m_f, 0, n_microbatches - 1)
-        inject = embed_fn(params["embed"], slice_mb(m_fc))
-        inp = jnp.where(valid_f & is_first, inject, held_f)
+        use_inject = valid_f & is_first
+        inject = _embed_inject(embed_fn, use_inject, params["embed"],
+                               slice_mb(m_fc), h_shape, h_dtype)
+        inp = jnp.where(use_inject, inject, held_f)
         out = stage_fn(params["stage"], inp)
         slot = m_fc % stash_slots
         cur = jax.lax.dynamic_index_in_dim(stash, slot, keepdims=False)
@@ -436,8 +458,10 @@ def forward_backward_pipelining_1f1b_interleaved_model(
         c_f = rem // P
         m_f = grp * P + rem % P
         pf = chunk_of(params["stage"], c_f)
-        inject = embed_fn(params["embed"], slice_mb(m_f))
-        inp = jnp.where(valid_f & (c_f == 0) & is_first, inject, held_f)
+        use_inject = valid_f & (c_f == 0) & is_first
+        inject = _embed_inject(embed_fn, use_inject, params["embed"],
+                               slice_mb(m_f), h_shape, h_dtype)
+        inp = jnp.where(use_inject, inject, held_f)
         out = stage_fn(pf, inp)
         slot = m_f % stash_slots
         cur = jax.lax.dynamic_index_in_dim(
@@ -535,6 +559,15 @@ def staged_group_scan(grad_of_group: Callable, params, xs,
     with RAW SUMS over groups; the caller owns the normalization (the
     schedule-level API documents the sum, the model-level API divides
     by ``n_groups``).
+
+    On the loss-scale asymmetry between the two public APIs (advisor
+    r4): a SUM-over-microbatches ``loss_head`` is the one class for
+    which grouping is exact (group sums add to the ungrouped total) —
+    so the schedule-level API returns the raw sum and stays exact for
+    that class, while ``PipelinedGPT.loss_and_grads`` divides by
+    ``n_groups`` because ITS loss is a per-group mean. Normalizing
+    inside the schedule would silently break the sum class instead;
+    the asymmetry is deliberate and both docstrings state their rule.
     """
     if group_size % n_stages != 0 or n_microbatches % group_size != 0:
         raise ValueError(

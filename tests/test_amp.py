@@ -58,6 +58,49 @@ def test_invalid_opt_level():
         amp.initialize(_mlp_apply, opt_level="O4")
 
 
+def test_initialize_enabled_false_passthrough():
+    """apex/amp/frontend.py:195-215 parity: enabled=False returns the
+    model and optimizer UNMODIFIED, and scale_loss yields the loss
+    unscaled (no scaler state exists)."""
+    from apex_tpu.optimizers import FusedSGD
+
+    opt = FusedSGD(lr=0.1)
+    try:
+        m, o = amp.initialize(_mlp_apply, opt, opt_level="O2",
+                              enabled=False)
+        assert m is _mlp_apply          # no AmpModel wrapper
+        assert o is opt
+        assert not hasattr(opt, "_amp_stash")   # optimizer untouched
+        loss = jnp.float32(3.5)
+        with amp.scale_loss(loss, o) as scaled:
+            assert float(scaled) == 3.5  # unscaled pass-through
+        # models-only form keeps its arity too
+        m2 = amp.initialize(_mlp_apply, opt_level="O2", enabled=False)
+        assert m2 is _mlp_apply
+        # flax-Module input keeps the (params, *args) calling convention
+        # on BOTH paths (the disabled path returns .apply, not the
+        # unbound module)
+        import flax.linen as nn
+
+        class _M(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(2)(x)
+
+        mod = _M()
+        m3 = amp.initialize(mod, opt_level="O2", enabled=False)
+        assert m3 == mod.apply
+        # 'enabled' is the 3rd positional arg (reference order); a
+        # positional opt_level from the pre-r5 order errors loudly
+        m4 = amp.initialize(_mlp_apply, None, False)
+        assert m4 is _mlp_apply
+        with pytest.raises(TypeError):
+            amp.initialize(_mlp_apply, None, "O2")
+    finally:
+        # restore enabled for the rest of the suite
+        amp.initialize(_mlp_apply, opt_level="O0")
+
+
 def test_overrides_win():
     m = amp.initialize(_mlp_apply, opt_level="O2", loss_scale=512.0,
                        keep_batchnorm_fp32=False)
