@@ -261,12 +261,17 @@ class MoEMLP(nn.Module):
             x = tp_mappings.gather_from_tensor_model_parallel_region(
                 x, ps.TENSOR_AXIS, 1)
         b, s, _ = x.shape
-        y, aux = expert_parallel_mlp(
+        y, aux, stats = expert_parallel_mlp(
             x.reshape(b * s, h), router, wi.astype(cfg.dtype),
             wo.astype(cfg.dtype),
             capacity_factor=cfg.moe_capacity_factor,
-            num_selected_experts=cfg.moe_top_k)
+            num_selected_experts=cfg.moe_top_k,
+            return_stats=True)
         self.sow("intermediates", "moe_aux", aux)
+        # routing health (judged datapoint + tests): fraction of desired
+        # assignments dropped for capacity; selected by key, so it never
+        # enters moe_aux_sum's objective
+        self.sow("intermediates", "moe_drop_frac", stats["drop_frac"])
         y = y.reshape(b, s, h)
         if sp:
             y = tp_mappings.scatter_to_tensor_model_parallel_region(

@@ -45,11 +45,13 @@ def _place(one_hot, offset, capacity: int):
     return d * keep.any(axis=-1)[:, None, None]
 
 
-def top1_routing(logits, capacity: int):
+def top1_routing(logits, capacity: int, with_stats: bool = False):
     """Switch top-1 routing with per-expert capacity.
 
     logits: [t, E]. Returns (dispatch [t, E, C] one-hot, combine
-    [t, E, C] gate-weighted, aux_loss scalar).
+    [t, E, C] gate-weighted, aux_loss scalar) — plus a routing-health
+    dict ``{"wanted", "placed"}`` (desired vs capacity-slotted
+    assignment counts) when ``with_stats``.
     """
     t, E = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
@@ -63,10 +65,14 @@ def top1_routing(logits, capacity: int):
     f = jnp.mean(one_hot, axis=0)
     p = jnp.mean(probs, axis=0)
     aux = E * jnp.sum(f * p)
+    if with_stats:
+        stats = {"wanted": jnp.float32(t),
+                 "placed": jnp.sum(dispatch, dtype=jnp.float32)}
+        return dispatch, combine, aux, stats
     return dispatch, combine, aux
 
 
-def top2_routing(logits, capacity: int):
+def top2_routing(logits, capacity: int, with_stats: bool = False):
     """GShard top-2 routing with per-expert capacity.
 
     logits: [t, E]. Each token is dispatched to its two highest-prob
@@ -104,6 +110,12 @@ def top2_routing(logits, capacity: int):
     f = jnp.mean(oh1, axis=0)
     p = jnp.mean(probs, axis=0)
     aux = E * jnp.sum(f * p)
+    if with_stats:
+        # wanted counts REAL assignments: every top-1 plus the live
+        # (non-ghost, p2 > 0) second choices
+        stats = {"wanted": jnp.float32(t) + jnp.sum(oh2, dtype=jnp.float32),
+                 "placed": jnp.sum(dispatch, dtype=jnp.float32)}
+        return dispatch, combine, aux, stats
     return dispatch, combine, aux
 
 
@@ -111,7 +123,8 @@ def expert_parallel_mlp(x, router_w, wi, wo, *,
                         axis_name: Optional[str] = ps.EXPERT_AXIS,
                         capacity_factor: float = 1.25,
                         activation: Callable = jax.nn.gelu,
-                        num_selected_experts: int = 1):
+                        num_selected_experts: int = 1,
+                        return_stats: bool = False):
     """Switch (top-1) / GShard (top-2) MoE MLP layer.
 
     x: [t, h] local tokens; router_w: [h, E_global] (replicated);
@@ -140,7 +153,8 @@ def expert_parallel_mlp(x, router_w, wi, wo, *,
     # so bf16 training keeps MXU rate on the FLOPs-dominant einsums
     logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
     routing = top1_routing if num_selected_experts == 1 else top2_routing
-    dispatch, combine, aux = routing(logits, capacity)
+    dispatch, combine, aux, rstats = routing(logits, capacity,
+                                             with_stats=True)
     # aux is computed from local tokens; average over the expert group so
     # every rank carries the same load-balancing scalar when x is sharded
     aux = ps.psum_if_bound(aux, axis_name) / ep
@@ -186,7 +200,12 @@ def expert_parallel_mlp(x, router_w, wi, wo, *,
 
     y = jnp.einsum("tec,ech->th", combine, expert_out,
                    preferred_element_type=jnp.float32)
-    return y.astype(x.dtype), aux
+    if not return_stats:
+        return y.astype(x.dtype), aux
+    wanted = ps.psum_if_bound(rstats["wanted"], axis_name)
+    placed = ps.psum_if_bound(rstats["placed"], axis_name)
+    stats = {"drop_frac": 1.0 - placed / jnp.maximum(wanted, 1.0)}
+    return y.astype(x.dtype), aux, stats
 
 
 class ExpertParallelMLP:
