@@ -53,6 +53,18 @@ def unscale(grads: Any, state: ScalerState, out_dtype=jnp.float32):
     ``(unscaled_grads, found_inf)``.
     """
     inv = jnp.where(state.loss_scale > 0, 1.0 / state.loss_scale, 1.0)
+    if any(g.dtype == jnp.float16 for g in jax.tree.leaves(grads)):
+        # fp16 on TPU is emulated with EXCESS PRECISION and rounding is
+        # applied per-fusion: without a barrier the overflow reduction
+        # and the downstream unscale/apply can be fused into different
+        # consumers seeing DIFFERENT values — measured on a v5e RN50
+        # fp16-O2 step: found_inf=False while the grads the optimizer
+        # consumed held inf, poisoning params with no skip (caught by
+        # the r5 convergence tier at step 0). The barrier pins ONE
+        # materialization of the fp16 grads that both the detection and
+        # the update then share. bf16/fp32 paths skip it (no fp16
+        # rounding ambiguity; the barrier would only block fusion).
+        grads = jax.lax.optimization_barrier(grads)
     found_inf = ~tree_all_finite(grads)
     out = jax.tree.map(
         lambda g: (g.astype(jnp.float32) * inv).astype(out_dtype)

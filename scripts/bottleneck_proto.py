@@ -1,0 +1,190 @@
+"""Fused conv1x1+BN+ReLU -> conv3x3+BN+ReLU -> conv1x1+BN + residual
+ReLU bottleneck block in ONE Pallas kernel vs XLA's composition — the
+measured decision the r4 verdict asked for (weak #3 / next #6): the only
+remaining RN50 lever named by the traffic accounting is cross-op fusion
+keeping the squeeze activations in VMEM (the reference's
+``fast_bottleneck``, ``apex/contrib/csrc/bottleneck/bottleneck.cpp``).
+
+Shape: the RN50 conv2_x bottleneck at inference/training-forward form
+(BN folded to scale+shift — the fusion question is about activation
+traffic, which is identical for folded and unfolded BN):
+
+    x [N, 56, 56, 256] -> 1x1 w1 [256, 64] -> bn+relu
+      -> 3x3 w2 [3, 3, 64, 64] (SAME) -> bn+relu
+      -> 1x1 w3 [64, 256] -> bn -> + x -> relu
+
+Pallas strategy: grid over (batch, 4x4 spatial tiles of 14x14); each
+program loads its x tile WITH a 1-px halo (16x16), runs the squeeze 1x1
+on the haloed tile (redundant halo compute: 64-ch, cheap), the 3x3 as 9
+shifted [14*14, 64] x [64, 64] MXU dots accumulated in fp32, the expand
+1x1, then adds the residual center and writes one [14, 14, 256] tile —
+the [*, 64] intermediates never touch HBM.
+
+Run:
+    PYTHONPATH=/root/repo python scripts/bottleneck_proto.py
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+N, H, W, C, S = 32, 56, 56, 256, 64     # batch, spatial, channels, squeeze
+TILE = 14                                # spatial tile (4x4 grid over 56)
+
+
+def make_params(dtype=jnp.bfloat16, seed=0):
+    rng = np.random.RandomState(seed)
+    p = {
+        "w1": rng.randn(C, S) * (2.0 / C) ** 0.5,
+        "w2": rng.randn(3, 3, S, S) * (2.0 / (9 * S)) ** 0.5,
+        "w3": rng.randn(S, C) * (2.0 / S) ** 0.5,
+        "g1": 1.0 + 0.1 * rng.randn(S), "b1": 0.1 * rng.randn(S),
+        "g2": 1.0 + 0.1 * rng.randn(S), "b2": 0.1 * rng.randn(S),
+        "g3": 1.0 + 0.1 * rng.randn(C), "b3": 0.1 * rng.randn(C),
+    }
+    return {k: jnp.asarray(v, dtype) for k, v in p.items()}
+
+
+def xla_block(x, p):
+    """The XLA composition (what ResNet.apply compiles to, with BN in
+    folded scale/shift form)."""
+    h = jax.lax.conv_general_dilated(
+        x, p["w1"][None, None], (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    h = jax.nn.relu(h * p["g1"].astype(jnp.float32)
+                    + p["b1"].astype(jnp.float32)).astype(x.dtype)
+    h = jax.lax.conv_general_dilated(
+        h, p["w2"], (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    h = jax.nn.relu(h * p["g2"].astype(jnp.float32)
+                    + p["b2"].astype(jnp.float32)).astype(x.dtype)
+    h = jax.lax.conv_general_dilated(
+        h, p["w3"][None, None], (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    h = h * p["g3"].astype(jnp.float32) + p["b3"].astype(jnp.float32)
+    return jax.nn.relu(h + x.astype(jnp.float32)).astype(x.dtype)
+
+
+def _kernel(x_ref, w1_ref, w2_ref, w3_ref, g1_ref, b1_ref, g2_ref,
+            b2_ref, g3_ref, b3_ref, o_ref):
+    """One [TILE, TILE, C] output tile from a haloed [TILE+2, TILE+2, C]
+    input tile."""
+    t2 = TILE + 2
+    x = x_ref[0]                                    # [t2, t2, C]
+    xf = x.reshape(t2 * t2, C)
+    # squeeze 1x1 + bn + relu on the haloed tile
+    h1 = jax.lax.dot_general(xf, w1_ref[...], (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    h1 = jax.nn.relu(h1 * g1_ref[...].astype(jnp.float32)
+                     + b1_ref[...].astype(jnp.float32))
+    h1 = h1.astype(x.dtype).reshape(t2, t2, S)
+    # 3x3 as 9 shifted matmuls over the 14x14 center
+    acc = jnp.zeros((TILE * TILE, S), jnp.float32)
+    for dy in range(3):
+        for dx in range(3):
+            patch = h1[dy:dy + TILE, dx:dx + TILE].reshape(TILE * TILE, S)
+            acc += jax.lax.dot_general(
+                patch, w2_ref[dy, dx], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+    h2 = jax.nn.relu(acc * g2_ref[...].astype(jnp.float32)
+                     + b2_ref[...].astype(jnp.float32)).astype(x.dtype)
+    # expand 1x1 + bn + residual + relu
+    h3 = jax.lax.dot_general(h2, w3_ref[...], (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    h3 = h3 * g3_ref[...].astype(jnp.float32) \
+        + b3_ref[...].astype(jnp.float32)
+    res = x[1:1 + TILE, 1:1 + TILE].reshape(TILE * TILE, C)
+    o_ref[0] = jax.nn.relu(h3 + res.astype(jnp.float32)) \
+        .astype(o_ref.dtype).reshape(TILE, TILE, C)
+
+
+def pallas_block(x, p):
+    """x [N, H, W, C] -> fused bottleneck. Pads a 1-px zero halo once
+    (HBM [N, H+2, W+2, C] copy) so every tile reads its halo with plain
+    block indexing."""
+    n = x.shape[0]
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    gt = H // TILE
+    grid = (n, gt, gt)
+
+    def xmap(b, i, j):
+        # block index units: (1, TILE+2, TILE+2, C) blocks... Pallas
+        # block indices multiply by the block shape, so overlapping halo
+        # tiles need element-offset indexing via a unit-1 block on the
+        # spatial dims — instead we use per-tile slices through a
+        # non-blocked spec (index_map in element units requires block
+        # shape 1; see the custom spec below).
+        return (b, i, j, 0)
+
+    # Overlapping (haloed) tiles cannot be expressed with standard
+    # multiplicative BlockSpecs; use input_output_aliasing-free manual
+    # gather: reshape trick — represent xp as [n, gt, TILE, gt, TILE, C]
+    # is also non-haloed. The practical Pallas form: pass xp whole to
+    # every program (memory_space=ANY) and slice in-kernel via pl.ds.
+    def kernel(x_hbm, w1, w2, w3, g1, b1, g2, b2, g3, b3, o_ref, x_vmem):
+        b = pl.program_id(0)
+        i = pl.program_id(1)
+        j = pl.program_id(2)
+        t2 = TILE + 2
+        # DMA the haloed tile HBM -> VMEM
+        cp = pltpu.make_async_copy(
+            x_hbm.at[b, pl.ds(i * TILE, t2), pl.ds(j * TILE, t2)],
+            x_vmem, None)
+        cp.start()
+        cp.wait()
+        _kernel(x_vmem[None], w1, w2, w3, g1, b1, g2, b2, g3, b3, o_ref)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] +
+                 [pl.BlockSpec(memory_space=pltpu.VMEM)] * 9,
+        out_specs=pl.BlockSpec((1, TILE, TILE, C),
+                               lambda b, i, j: (b, i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, H, W, C), x.dtype),
+        scratch_shapes=[pltpu.VMEM((TILE + 2, TILE + 2, C), x.dtype)],
+    )(xp, p["w1"], p["w2"], p["w3"], p["g1"], p["b1"], p["g2"], p["b2"],
+      p["g3"], p["b3"])
+    return out
+
+
+def timed(fn, x, p, k=64, windows=5):
+    @jax.jit
+    def g(x):
+        def body(c, _):
+            y = fn(c, p)
+            return y, ()
+        c, _ = jax.lax.scan(body, x, None, length=k)
+        return jnp.sum(c.astype(jnp.float32))
+
+    float(g(x))
+    ts = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        float(g(x))
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[2] / k * 1e3
+
+
+if __name__ == "__main__":
+    p = make_params()
+    x = jnp.asarray(np.random.RandomState(1).randn(N, H, W, C) * 0.5,
+                    jnp.bfloat16)
+    y_ref = xla_block(x, p)
+    y_fused = pallas_block(x, p)
+    err = float(jnp.max(jnp.abs(y_ref.astype(jnp.float32)
+                                - y_fused.astype(jnp.float32))))
+    print("max abs err fused vs XLA:", err)
+    assert err < 0.15, err    # bf16 conv parity at these magnitudes
+    t_xla = timed(xla_block, x, p)
+    t_fused = timed(pallas_block, x, p)
+    print(f"XLA composition : {t_xla:.3f} ms")
+    print(f"Pallas fused    : {t_fused:.3f} ms   "
+          f"({t_xla / t_fused:.2f}x)")

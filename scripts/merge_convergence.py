@@ -1,0 +1,19 @@
+"""Merge per-config convergence JSONs (scripts/run_convergence.sh) into
+the judged CONVERGENCE_r05.json, recomputing the cross-config checks via
+bench.convergence_checks (one place owns thresholds AND the
+completeness guard — a missing baseline yields all_ok=false with the
+missing list, never a vacuous pass)."""
+import glob
+import json
+import sys
+
+import bench
+
+out = {"steps": 500, "subsample": 20, "rn50": {}, "gpt": {}}
+for f in glob.glob(sys.argv[1] + "/*.json"):
+    d = json.load(open(f))
+    for fam in ("rn50", "gpt"):
+        out[fam].update(d.get(fam, {}))
+
+out.update(bench.convergence_checks(out))
+print(json.dumps(out, indent=1))

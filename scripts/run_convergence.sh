@@ -1,0 +1,22 @@
+#!/bin/bash
+# Produce CONVERGENCE_r05.json: run each convergence config in its own
+# process (compile time per config is ~3-5 min; a single run would blow
+# any sane timeout), then merge. Run from the repo root on the TPU host.
+set -e
+OUT=${1:-/tmp/conv}
+mkdir -p "$OUT"
+for cfg in O0 O1_bf16 O2_bf16 O2_fp16_dynamic O2_fp16_static128; do
+  python -c "
+import json, bench
+out = bench._bench_convergence(families=('rn50',), only='$cfg')
+json.dump(out, open('$OUT/rn50_$cfg.json', 'w'))
+"
+done
+for cfg in fp32 bf16 bf16_dynamic_scaler; do
+  python -c "
+import json, bench
+out = bench._bench_convergence(families=('gpt',), only='$cfg')
+json.dump(out, open('$OUT/gpt_$cfg.json', 'w'))
+"
+done
+python scripts/merge_convergence.py "$OUT" > CONVERGENCE_r05.json
