@@ -13,12 +13,25 @@ traffic, which is identical for folded and unfolded BN):
       -> 3x3 w2 [3, 3, 64, 64] (SAME) -> bn+relu
       -> 1x1 w3 [64, 256] -> bn -> + x -> relu
 
-Pallas strategy: grid over (batch, 4x4 spatial tiles of 14x14); each
-program loads its x tile WITH a 1-px halo (16x16), runs the squeeze 1x1
-on the haloed tile (redundant halo compute: 64-ch, cheap), the 3x3 as 9
-shifted [14*14, 64] x [64, 64] MXU dots accumulated in fp32, the expand
-1x1, then adds the residual center and writes one [14, 14, 256] tile —
-the [*, 64] intermediates never touch HBM.
+Pallas strategy: grid over (batch, 4 row strips of 14 x 56); each
+program DMAs its strip WITH a 1-px halo ([16, 58] x C) into VMEM, runs
+the squeeze 1x1 on the haloed strip (redundant halo compute: 64-ch,
+cheap), the 3x3 as 9 shifted [14*56, 64] x [64, 64] MXU dots
+accumulated in fp32, the expand 1x1, then adds the residual center and
+writes one [14, 56, 256] strip — the [*, 64] intermediates never touch
+HBM. (Full-width strips keep the output block's trailing dims equal to
+the array dims, the Mosaic tiling rule.)
+
+MEASURED RESULT (v5e, N=32, bf16, k=64 scanned): XLA 1.841 ms vs the
+fused kernel 2.046/1.848/1.832 ms at TILE=14/28/56 — parity at best,
+no win. The r4 traffic accounting estimated <=30% from removing the
+h1/h2 HBM round trips; measured, those round trips are ~51 MB
+(~0.06 ms at HBM rate) of a 1.84 ms block — NOT the binding cost at
+this shape (both versions run ~8x above their flop AND traffic
+rooflines; the block is bound by conv lowering/layout overheads that
+fusion does not touch). The fast_bottleneck path is therefore a
+measured NULL on v5e, recorded in docs/perf.md; the kernel stays here
+as the prototype + parity harness (max |err| vs XLA = 0.0156 bf16).
 
 Run:
     PYTHONPATH=/root/repo python scripts/bottleneck_proto.py
@@ -33,7 +46,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 N, H, W, C, S = 32, 56, 56, 256, 64     # batch, spatial, channels, squeeze
-TILE = 14                                # spatial tile (4x4 grid over 56)
+TILE = 56   # one strip per image measured fastest (1.832 ms vs 2.046 at
+            # TILE=14, 1.848 at 28); XLA composition: 1.841 ms — a WASH
 
 
 def make_params(dtype=jnp.bfloat16, seed=0):
@@ -74,22 +88,34 @@ def xla_block(x, p):
 
 def _kernel(x_ref, w1_ref, w2_ref, w3_ref, g1_ref, b1_ref, g2_ref,
             b2_ref, g3_ref, b3_ref, o_ref):
-    """One [TILE, TILE, C] output tile from a haloed [TILE+2, TILE+2, C]
-    input tile."""
-    t2 = TILE + 2
-    x = x_ref[0]                                    # [t2, t2, C]
-    xf = x.reshape(t2 * t2, C)
-    # squeeze 1x1 + bn + relu on the haloed tile
+    """One [TILE, W, C] output strip from a haloed [TILE+2, W+2, C]
+    input strip. The h1 halo ring at OUTSIDE-GRID positions is zeroed
+    to match XLA's SAME-padding semantics for the 3x3 (the bn bias
+    makes h1(0-input) = relu(b1) != 0 otherwise)."""
+    t2, w2p = TILE + 2, W + 8   # W padded to 64: Mosaic tiles the last
+    # two dims (8, 128) and DMA slices must be tile-aligned — 58 is not
+    x = x_ref[...]                                  # [t2, w2p, C]
+    xf = x.reshape(t2 * w2p, C)
+    # squeeze 1x1 + bn + relu on the haloed strip
     h1 = jax.lax.dot_general(xf, w1_ref[...], (((1,), (0,)), ((), ())),
                              preferred_element_type=jnp.float32)
     h1 = jax.nn.relu(h1 * g1_ref[...].astype(jnp.float32)
                      + b1_ref[...].astype(jnp.float32))
-    h1 = h1.astype(x.dtype).reshape(t2, t2, S)
-    # 3x3 as 9 shifted matmuls over the 14x14 center
-    acc = jnp.zeros((TILE * TILE, S), jnp.float32)
+    h1 = h1.astype(x.dtype).reshape(t2, w2p, S)
+    # zero h1 where the position is outside the [H, W] grid: global row
+    # = i*TILE + r - 1, global col = c - 1
+    i = pl.program_id(1)
+    r = jax.lax.broadcasted_iota(jnp.int32, (t2, w2p, 1), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (t2, w2p, 1), 1)
+    grow = i * TILE + r - 1
+    gcol = c - 1
+    inside = ((grow >= 0) & (grow < H) & (gcol >= 0) & (gcol < W))
+    h1 = jnp.where(inside, h1, 0)
+    # 3x3 as 9 shifted matmuls over the [TILE, W] center
+    acc = jnp.zeros((TILE * W, S), jnp.float32)
     for dy in range(3):
         for dx in range(3):
-            patch = h1[dy:dy + TILE, dx:dx + TILE].reshape(TILE * TILE, S)
+            patch = h1[dy:dy + TILE, dx:dx + W].reshape(TILE * W, S)
             acc += jax.lax.dot_general(
                 patch, w2_ref[dy, dx], (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -100,9 +126,9 @@ def _kernel(x_ref, w1_ref, w2_ref, w3_ref, g1_ref, b1_ref, g2_ref,
                              preferred_element_type=jnp.float32)
     h3 = h3 * g3_ref[...].astype(jnp.float32) \
         + b3_ref[...].astype(jnp.float32)
-    res = x[1:1 + TILE, 1:1 + TILE].reshape(TILE * TILE, C)
+    res = x[1:1 + TILE, 1:1 + W].reshape(TILE * W, C)
     o_ref[0] = jax.nn.relu(h3 + res.astype(jnp.float32)) \
-        .astype(o_ref.dtype).reshape(TILE, TILE, C)
+        .astype(o_ref.dtype).reshape(TILE, W, C)
 
 
 def pallas_block(x, p):
@@ -110,46 +136,34 @@ def pallas_block(x, p):
     (HBM [N, H+2, W+2, C] copy) so every tile reads its halo with plain
     block indexing."""
     n = x.shape[0]
-    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    # 1-px halo; W additionally padded to 64 for Mosaic tile alignment
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 7), (0, 0)))
     gt = H // TILE
-    grid = (n, gt, gt)
+    grid = (n, gt)
 
-    def xmap(b, i, j):
-        # block index units: (1, TILE+2, TILE+2, C) blocks... Pallas
-        # block indices multiply by the block shape, so overlapping halo
-        # tiles need element-offset indexing via a unit-1 block on the
-        # spatial dims — instead we use per-tile slices through a
-        # non-blocked spec (index_map in element units requires block
-        # shape 1; see the custom spec below).
-        return (b, i, j, 0)
-
-    # Overlapping (haloed) tiles cannot be expressed with standard
-    # multiplicative BlockSpecs; use input_output_aliasing-free manual
-    # gather: reshape trick — represent xp as [n, gt, TILE, gt, TILE, C]
-    # is also non-haloed. The practical Pallas form: pass xp whole to
-    # every program (memory_space=ANY) and slice in-kernel via pl.ds.
-    def kernel(x_hbm, w1, w2, w3, g1, b1, g2, b2, g3, b3, o_ref, x_vmem):
+    # Overlapping (haloed) strips cannot be expressed with standard
+    # multiplicative BlockSpecs: pass xp whole (memory_space=ANY) and
+    # DMA each program's haloed strip in-kernel via pl.ds.
+    def kernel(x_hbm, w1, w2, w3, g1, b1, g2, b2, g3, b3, o_ref, x_vmem,
+               sem):
         b = pl.program_id(0)
         i = pl.program_id(1)
-        j = pl.program_id(2)
-        t2 = TILE + 2
-        # DMA the haloed tile HBM -> VMEM
         cp = pltpu.make_async_copy(
-            x_hbm.at[b, pl.ds(i * TILE, t2), pl.ds(j * TILE, t2)],
-            x_vmem, None)
+            x_hbm.at[b, pl.ds(i * TILE, TILE + 2)], x_vmem, sem)
         cp.start()
         cp.wait()
-        _kernel(x_vmem[None], w1, w2, w3, g1, b1, g2, b2, g3, b3, o_ref)
+        _kernel(x_vmem, w1, w2, w3, g1, b1, g2, b2, g3, b3, o_ref)
 
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] +
                  [pl.BlockSpec(memory_space=pltpu.VMEM)] * 9,
-        out_specs=pl.BlockSpec((1, TILE, TILE, C),
-                               lambda b, i, j: (b, i, j, 0)),
+        out_specs=pl.BlockSpec((1, TILE, W, C),
+                               lambda b, i: (b, i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((n, H, W, C), x.dtype),
-        scratch_shapes=[pltpu.VMEM((TILE + 2, TILE + 2, C), x.dtype)],
+        scratch_shapes=[pltpu.VMEM((TILE + 2, W + 8, C), x.dtype),
+                        pltpu.SemaphoreType.DMA],
     )(xp, p["w1"], p["w2"], p["w3"], p["g1"], p["b1"], p["g2"], p["b2"],
       p["g3"], p["b3"])
     return out
