@@ -1072,14 +1072,16 @@ def main():
         except Exception as e:
             extras["convergence_error"] = f"{type(e).__name__}: {e}"[:120]
         try:
-            extras["ring_s32k"] = _bench_ring_s32k()
+            bert_tps, bert_mfu, bert_ops, bert_iqr, bert_disp = _bench_bert()
+            extras["bert_tokens_per_sec"] = round(bert_tps, 1)
+            if bert_mfu:
+                extras["bert_mfu"] = round(bert_mfu, 4)
+            extras["bert_step_iqr_ms"] = round(bert_iqr * 1e3, 3)
+            extras["bert_step_ms_per_dispatch"] = round(bert_disp * 1e3, 2)
+            if bert_ops:
+                extras["bert_top_ops"] = bert_ops
         except Exception as e:
-            extras["ring_s32k_error"] = f"{type(e).__name__}: {e}"[:120]
-        try:
-            extras["dispatch_overhead"] = _bench_dispatch_overhead()
-        except Exception as e:
-            extras["dispatch_overhead_error"] = \
-                f"{type(e).__name__}: {e}"[:120]
+            extras["bert_error"] = f"{type(e).__name__}: {e}"[:120]
         try:
             (moe_tps, moe_dt, moe_iqr), (t1_tps, t1_dt, t1_iqr), \
                 moe_mfu, moe_health = _bench_gpt_moe()
@@ -1093,17 +1095,16 @@ def main():
             extras["gpt_moe_routing"] = moe_health
         except Exception as e:
             extras["gpt_moe_error"] = f"{type(e).__name__}: {e}"[:120]
+        # new r5 extras LAST: core metrics survive a driver deadline
         try:
-            bert_tps, bert_mfu, bert_ops, bert_iqr, bert_disp = _bench_bert()
-            extras["bert_tokens_per_sec"] = round(bert_tps, 1)
-            if bert_mfu:
-                extras["bert_mfu"] = round(bert_mfu, 4)
-            extras["bert_step_iqr_ms"] = round(bert_iqr * 1e3, 3)
-            extras["bert_step_ms_per_dispatch"] = round(bert_disp * 1e3, 2)
-            if bert_ops:
-                extras["bert_top_ops"] = bert_ops
+            extras["ring_s32k"] = _bench_ring_s32k()
         except Exception as e:
-            extras["bert_error"] = f"{type(e).__name__}: {e}"[:120]
+            extras["ring_s32k_error"] = f"{type(e).__name__}: {e}"[:120]
+        try:
+            extras["dispatch_overhead"] = _bench_dispatch_overhead()
+        except Exception as e:
+            extras["dispatch_overhead_error"] = \
+                f"{type(e).__name__}: {e}"[:120]
         import jax
         print(json.dumps({
             "metric": "resnet50_O2_train_throughput",
