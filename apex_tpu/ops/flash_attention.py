@@ -211,21 +211,35 @@ def _causal_block_full(qi, kb, block_q, block_k, causal_offset):
 
 
 def _dispatch_causal(compute, causal, use_segments, qi, kb, block_q,
-                     block_k, causal_offset):
+                     block_k, causal_offset, skip_dead=True):
     """Run ``compute(masked: bool)`` under the right predication — shared
     by all four kernels. Causal without segments splits live blocks into
     fully-live (mask-free, see ``_causal_block_full``; bit-identical
     since where(True, s, _) is the identity) and diagonal (mask built
     and applied); causal with segments predicates on liveness only; all
-    other shapes run unconditionally, masked iff segments are present."""
+    other shapes run unconditionally, masked iff segments are present.
+
+    ``skip_dead=False`` (the single-k-block FORWARD): dead causal blocks
+    must still run the masked compute — the n_kb==1 specialization
+    writes o/lse inside ``compute``, so a skipped block would leave its
+    output block uninitialized (VMEM garbage on hardware). The mask +
+    dead-row guard turn those rows into zeros/-1e30 lse, matching the
+    carry path's initialized-scratch behavior."""
     if causal and not use_segments:
         full = _causal_block_full(qi, kb, block_q, block_k, causal_offset)
-        live = _causal_block_live(qi, kb, block_q, block_k, causal_offset)
         pl.when(full)(lambda: compute(False))
-        pl.when(live & jnp.logical_not(full))(lambda: compute(True))
+        rest = jnp.logical_not(full)
+        if skip_dead:
+            rest &= _causal_block_live(qi, kb, block_q, block_k,
+                                       causal_offset)
+        pl.when(rest)(lambda: compute(True))
     elif causal:
-        live = _causal_block_live(qi, kb, block_q, block_k, causal_offset)
-        pl.when(live)(lambda: compute(True))
+        if skip_dead:
+            live = _causal_block_live(qi, kb, block_q, block_k,
+                                      causal_offset)
+            pl.when(live)(lambda: compute(True))
+        else:
+            compute(True)
     else:
         compute(use_segments)
 
@@ -235,7 +249,7 @@ def _dispatch_causal(compute, causal, use_segments, qi, kb, block_q,
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(*refs, scale, causal, block_q, block_k, use_segments,
-                use_bias, dropout_rate, causal_offset):
+                use_bias, dropout_rate, causal_offset, single_kb=False):
     it = iter(refs)
     sq_ref = next(it) if use_segments else None
     skv_ref = next(it) if use_segments else None
@@ -247,11 +261,12 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, use_segments,
                       pl.program_id(2), pl.program_id(3))
     n_kb = pl.num_programs(3)
 
-    @pl.when(kb == 0)
-    def _init():
-        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
+    if not single_kb:
+        @pl.when(kb == 0)
+        def _init():
+            m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+            l_scr[:] = jnp.zeros_like(l_scr)
+            acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def _compute(masked):
         # operands stay in their native dtype: the MXU multiplies bf16
@@ -271,6 +286,33 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, use_segments,
                             sq_ref, skv_ref) if masked else None)
         if mask is not None:
             s = jnp.where(mask, s, _NEG_INF)
+
+        if single_kb:
+            # n_kb == 1 specialization (r5): every row sees its FULL key
+            # range in this one block, so the online-softmax carry —
+            # m/l scratch round trips, alpha rescale, acc_scr
+            # init/mul/readback — is pure overhead. Compute the exact
+            # softmax and write the outputs directly.
+            # floor at _NEG_INF like the carry path's m_prev init: an
+            # all -inf additive-bias row otherwise gives m = -inf and
+            # s - m = NaN (the old path returned a zero row)
+            m = jnp.maximum(jnp.max(s, axis=1, keepdims=True), _NEG_INF)
+            p = jnp.exp(s - m)
+            if mask is not None and (use_segments or use_bias
+                                     or causal_offset < 0):
+                p = jnp.where(mask, p, 0.0)      # dead-row guard (below)
+            l = jnp.sum(p, axis=1, keepdims=True)
+            if dropout_rate > 0.0:
+                keep = _dropout_keep(seed_ref, bi, hi, qi, kb, block_q,
+                                     block_k, dropout_rate)
+                p = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout_rate))
+            acc = jax.lax.dot_general(
+                p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            safe_l = jnp.where(l > 0, l, 1.0)
+            o_ref[0, 0] = (acc / safe_l).astype(o_ref.dtype)
+            lse_ref[0, 0, 0] = jnp.reshape(m + jnp.log(safe_l), (block_q,))
+            return
 
         m_prev = m_scr[:]                                 # [block_q, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -304,18 +346,20 @@ def _fwd_kernel(*refs, scale, causal, block_q, block_k, use_segments,
         l_scr[:] = l_new
 
     _dispatch_causal(_compute, causal, use_segments, qi, kb, block_q,
-                     block_k, causal_offset)
+                     block_k, causal_offset, skip_dead=not single_kb)
 
-    @pl.when(kb == n_kb - 1)
-    def _finish():
-        l = l_scr[:]
-        safe_l = jnp.where(l > 0, l, 1.0)
-        o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
-        # lse is [b, h, 1, sq] (sequence on the lane dim: a [..., sq, 1]
-        # layout pads the trailing unit dim to 128 lanes — 128x memory and
-        # DMA traffic); the [block_q, 1] scratch relayouts to lanes here,
-        # once per q-block
-        lse_ref[0, 0, 0] = jnp.reshape(m_scr[:] + jnp.log(safe_l), (block_q,))
+    if not single_kb:
+        @pl.when(kb == n_kb - 1)
+        def _finish():
+            l = l_scr[:]
+            safe_l = jnp.where(l > 0, l, 1.0)
+            o_ref[0, 0] = (acc_scr[:] / safe_l).astype(o_ref.dtype)
+            # lse is [b, h, 1, sq] (sequence on the lane dim: a
+            # [..., sq, 1] layout pads the trailing unit dim to 128
+            # lanes — 128x memory and DMA traffic); the [block_q, 1]
+            # scratch relayouts to lanes here, once per q-block
+            lse_ref[0, 0, 0] = jnp.reshape(m_scr[:] + jnp.log(safe_l),
+                                           (block_q,))
 
 
 def _pad_operands(q, k, v, segment_ids_q, segment_ids_kv, bias, do,
@@ -397,7 +441,8 @@ def _flash_fwd_impl(q, k, v, segment_ids_q, segment_ids_kv, bias, seed,
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, use_segments=use_segments, use_bias=use_bias,
-        dropout_rate=dropout_rate, causal_offset=causal_offset)
+        dropout_rate=dropout_rate, causal_offset=causal_offset,
+        single_kb=(sk_p // block_k == 1))
 
     # Mosaic requires the last two block dims to be (8k, 128k) or equal to
     # the array dims — trailing-singleton layouts (b, sq, 1) / (b, 1, sk)
@@ -432,11 +477,17 @@ def _flash_fwd_impl(q, k, v, segment_ids_q, segment_ids_kv, bias, seed,
             jax.ShapeDtypeStruct((b, h, sq_p, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, 1, sq_p), jnp.float32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
-        ],
+        scratch_shapes=(
+            # minimal-tile dummies when single_kb: the specialization
+            # never touches the carry scratch, and (block_q, d) fp32
+            # would waste ~256 KB of the VMEM the block defaults are
+            # budgeted against (measured perf-neutral)
+            [pltpu.VMEM((8, 128), jnp.float32)] * 3
+            if sk_p // block_k == 1 else [
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, d), jnp.float32),
+            ]),
         interpret=interpret,
     )(*operands)
     return out[:, :, :sq], lse[:, :, 0, :sq]

@@ -180,6 +180,38 @@ def test_flash_bias_shape_validation():
         flash_attention(q, k, v, bias=jnp.zeros((2, 3, 16, 32)))
 
 
+def test_flash_single_block_causal_sq_gt_sk_dead_rows():
+    """Regression (r5 single-kb specialization): causal with sq > sk
+    leaves the leading q rows with NO visible key; at n_kb == 1 those
+    dead blocks must still be WRITTEN (zero rows, -1e30-ish lse), not
+    skipped (uninitialized VMEM on hardware)."""
+    b, h, sq, sk, d = 1, 2, 64, 16, 8
+    q, k, v = _qkv(b, h, sq, sk, d, seed=17)
+    out = flash_attention(q, k, v, causal=True)     # single k block
+    out = np.asarray(out.astype(jnp.float32))
+    # rows 0..sq-sk-1 see no key (causal_offset = sk - sq < 0)
+    dead = sq - sk
+    np.testing.assert_array_equal(out[:, :, :dead], 0.0)
+    ref = np.asarray(mha_reference(q, k, v, causal=True)
+                     .astype(jnp.float32))
+    np.testing.assert_allclose(out[:, :, dead:], ref[:, :, dead:],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_flash_single_block_neg_inf_bias_row_zero():
+    """Regression (r5): a fully -inf additive-bias row at n_kb == 1
+    (mask is None: non-causal, unsegmented, block-aligned) must give a
+    ZERO output row, not NaN — the exact-softmax row max is floored at
+    -1e30 like the carry path's m_prev."""
+    b, h, s, d = 1, 2, 32, 8
+    q, k, v = _qkv(b, h, s, s, d, seed=19)
+    bias = jnp.zeros((1, 1, s, s), jnp.float32).at[:, :, 3, :].set(-jnp.inf)
+    out = np.asarray(flash_attention(q, k, v, bias=bias)
+                     .astype(jnp.float32))
+    assert np.isfinite(out).all()
+    np.testing.assert_array_equal(out[:, :, 3], 0.0)
+
+
 def test_flash_causal_bias_neg_inf_row_no_future_leak():
     """Regression (r5): a -1e30 additive-bias row under causal pushes
     every LIVE score down to the causal fill value (-1e30 absorbs any
