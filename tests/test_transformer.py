@@ -1222,7 +1222,12 @@ def test_pipelined_gpt_moe_matches_sequential(sp):
                 for g in range(P_ * V):
                     xm, mut = block.apply({"params": p["blocks"][g]}, xm,
                                           True, mutable=["intermediates"])
-                    aux = aux + sum(jax.tree.leaves(mut["intermediates"]))
+                    # key-filtered like the production paths: the r5
+                    # moe_drop_frac diagnostic sow must not enter the
+                    # objective (a raw leaf sum regressed here when it
+                    # landed)
+                    from apex_tpu.models.gpt import moe_aux_sum
+                    aux = aux + moe_aux_sum(mut["intermediates"])
                 logits = head.apply({"params": p["head"]}, xm)
                 ce_sum = ce_sum + jnp.mean(
                     vocab_parallel_cross_entropy(logits, labels[m]))
