@@ -12,7 +12,8 @@ import numpy as np
 
 
 def time_fa(b=8, h=16, s=1024, d=64, causal=True, k=32, windows=5,
-            block_q=None, block_k=None, dtype=jnp.bfloat16, layers=12):
+            block_q=None, block_k=None, block_q_bwd=None, block_k_bwd=None,
+            dtype=jnp.bfloat16, layers=12):
     from apex_tpu.ops.flash_attention import flash_attention
 
     rng = np.random.RandomState(0)
@@ -23,7 +24,9 @@ def time_fa(b=8, h=16, s=1024, d=64, causal=True, k=32, windows=5,
     def one(q, kk, v):
         def loss(q, kk, v):
             o = flash_attention(q, kk, v, causal=causal,
-                                block_q=block_q, block_k=block_k)
+                                block_q=block_q, block_k=block_k,
+                                block_q_bwd=block_q_bwd,
+                                block_k_bwd=block_k_bwd)
             return jnp.sum(o.astype(jnp.float32))
         g = jax.grad(loss, argnums=(0, 1, 2))(q, kk, v)
         return g
