@@ -180,6 +180,27 @@ def test_flash_bias_shape_validation():
         flash_attention(q, k, v, bias=jnp.zeros((2, 3, 16, 32)))
 
 
+def test_flash_split_phase_blocks_match():
+    """r5 API: explicit block_q_bwd/block_k_bwd different from the
+    forward blocks must produce the same values and gradients as one
+    uniform tiling (the phase split is a pure scheduling choice)."""
+    b, h, s, d = 2, 3, 128, 8
+    q, k, v = _qkv(b, h, s, s, d, seed=23)
+
+    def loss(q, k, v, **kw):
+        return jnp.sum(jnp.tanh(flash_attention(q, k, v, causal=True,
+                                                **kw)))
+
+    base = jax.grad(loss, (0, 1, 2))(q, k, v, block_q=64, block_k=64,
+                                     block_q_bwd=64, block_k_bwd=64)
+    split = jax.grad(loss, (0, 1, 2))(q, k, v, block_q=128, block_k=128,
+                                      block_q_bwd=32, block_k_bwd=64)
+    for a, b_ in zip(base, split):
+        np.testing.assert_allclose(
+            np.asarray(b_.astype(jnp.float32)),
+            np.asarray(a.astype(jnp.float32)), rtol=1e-4, atol=1e-5)
+
+
 def test_flash_single_block_causal_sq_gt_sk_dead_rows():
     """Regression (r5 single-kb specialization): causal with sq > sk
     leaves the leading q rows with NO visible key; at n_kb == 1 those
