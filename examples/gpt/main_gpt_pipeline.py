@@ -14,6 +14,13 @@ rampup (``--rampup``).
 Run (8 virtual devices, dp=2 x pp=2 x tp=2, vpp=2):
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python examples/gpt/main_gpt_pipeline.py --steps 10
+
+``--schedule interleaved_1f1b`` (r5) swaps the grad-of-scan interleaved
+schedule for Megatron's production interleaved 1F1B: same vpp chunks,
+flat activation memory (a [vpp, 2·pp+1]-slot stash instead of one
+residual per tick), no per-group bubbles — use it when nmb is large
+and memory-bound. Incompatible with --microbatch_group_size (the 1F1B
+schedule IS the memory bound) and with MoE/SP configs.
 """
 
 from __future__ import annotations
@@ -38,6 +45,9 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--tp", type=int, default=2)
     p.add_argument("--pp", type=int, default=2)
+    p.add_argument("--schedule", choices=["interleaved",
+                                          "interleaved_1f1b"],
+                   default="interleaved")
     p.add_argument("--vpp", type=int, default=2)
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--micro-batch", type=int, default=2)
@@ -80,9 +90,17 @@ def main():
         return params, dopt.init(params), scaler_mod.init_state(2.0 ** 12)
 
     def train_step(params, opt_state, sstate, ids_mb, labels_mb):
-        loss, grads = pgpt.loss_and_grads(
-            params, ids_mb, labels_mb, loss_scale=sstate.loss_scale,
-            microbatch_group_size=args.microbatch_group_size)
+        if args.schedule == "interleaved_1f1b":
+            if args.microbatch_group_size:
+                raise SystemExit("--schedule interleaved_1f1b already has "
+                                 "flat memory; drop "
+                                 "--microbatch_group_size")
+            loss, grads = pgpt.loss_and_grads_1f1b_interleaved(
+                params, ids_mb, labels_mb, loss_scale=sstate.loss_scale)
+        else:
+            loss, grads = pgpt.loss_and_grads(
+                params, ids_mb, labels_mb, loss_scale=sstate.loss_scale,
+                microbatch_group_size=args.microbatch_group_size)
         # no dp pmean: DistributedFusedAdam's psum_scatter over the data
         # axis already averages (ZeRO); unscale is linear and commutes
         grads, found_inf = scaler_mod.unscale(grads, sstate)
