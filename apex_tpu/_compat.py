@@ -1,0 +1,91 @@
+"""Version-tolerance shims for JAX API drift.
+
+The package pins no exact jax version; the APIs it leans on have moved
+across the releases we must run under:
+
+- Pallas TPU compiler params: ``pltpu.TPUCompilerParams`` (jax <= 0.4.x /
+  0.5.x) was renamed ``pltpu.CompilerParams`` (jax >= 0.6). Building
+  either at module import time turns an API rename into an
+  ``AttributeError`` that takes out every importer at *collection* —
+  exactly what broke 13 test files in the seed. ``tpu_compiler_params``
+  resolves the name at call time, so importers stay importable and the
+  failure (if any) surfaces where a kernel is actually launched.
+- ``shard_map``: top-level ``jax.shard_map`` (new) vs
+  ``jax.experimental.shard_map.shard_map`` (0.4.x), with the replication
+  check keyword renamed ``check_rep`` -> ``check_vma`` along the way.
+
+Import-time rule (enforced by ``apex_tpu.lint`` APX001): this module may
+*locate* the symbols lazily but must not construct JAX objects or touch a
+backend at import.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+
+@functools.lru_cache(maxsize=None)
+def _compiler_params_cls():
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = getattr(pltpu, "TPUCompilerParams", None)
+    if cls is None:  # pragma: no cover - pallas too old/new to support
+        raise AttributeError(
+            "jax.experimental.pallas.tpu exposes neither CompilerParams "
+            "nor TPUCompilerParams; unsupported jax version")
+    return cls
+
+
+def tpu_compiler_params(**kwargs: Any):
+    """Build Pallas TPU compiler params under whichever name this jax
+    ships (``CompilerParams`` vs ``TPUCompilerParams``).
+
+    Call it inside the function that issues the ``pallas_call`` — never at
+    module level (APX001).
+    """
+    return _compiler_params_cls()(**kwargs)
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` (new) with a pre-rename fallback.
+
+    Older jax has no ``lax.axis_size``; ``psum`` of a unit Python scalar
+    is statically folded to the axis size by the axis env (an ``int`` at
+    trace time, verified), and raises the same ``NameError`` on an
+    unbound axis — so the two spellings are interchangeable.
+    """
+    import jax
+
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+@functools.lru_cache(maxsize=None)
+def _shard_map_impl():
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn, "check_vma"
+    from jax.experimental.shard_map import shard_map as fn
+
+    return fn, "check_rep"
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, **kwargs):
+    """``jax.shard_map`` with the replication-check keyword bridged.
+
+    Accepts either ``check_vma`` (new spelling) or ``check_rep`` (old) and
+    forwards whichever the underlying jax understands.
+    """
+    impl, check_kw = _shard_map_impl()
+    check = kwargs.pop("check_vma", kwargs.pop("check_rep", None))
+    if check is not None:
+        kwargs[check_kw] = check
+    return impl(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                **kwargs)

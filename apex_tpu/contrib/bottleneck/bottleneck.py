@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.models.resnet import Bottleneck  # the fused-block graph
+from apex_tpu._compat import axis_size as _axis_size
 
 
 def halo_exchange(x, axis_name: str, halo: int = 1):
@@ -33,7 +34,7 @@ def halo_exchange(x, axis_name: str, halo: int = 1):
     get zero halos (edge padding), matching the reference's halo handling
     at the volume boundary (``bottleneck.py:218+``).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     top = x[:, :halo]        # rows to send upward (to rank-1)
     bot = x[:, -halo:]       # rows to send downward (to rank+1)

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm, create_syncbn_process_group
+from apex_tpu._compat import axis_size as _axis_size
 
 
 class BatchNorm2d_NHWC(nn.Module):
@@ -36,7 +37,7 @@ class BatchNorm2d_NHWC(nn.Module):
             if ws is None:
                 try:
                     # static axis size at trace time
-                    ws = jax.lax.axis_size(self.axis_name)
+                    ws = _axis_size(self.axis_name)
                 except NameError:
                     # e.g. Module.init outside shard_map — single device,
                     # no group construction (same guard as SyncBatchNorm)

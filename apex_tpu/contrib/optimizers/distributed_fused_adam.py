@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.utils.flat import FlatBuffer
+from apex_tpu._compat import axis_size as _axis_size
 
 
 class ShardedAdamState(NamedTuple):
@@ -60,7 +61,7 @@ class DistributedFusedAdam:
 
     def _world(self):
         try:
-            return jax.lax.axis_size(self.axis_name)
+            return _axis_size(self.axis_name)
         except NameError:
             return 1
 

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.utils.flat import FlatBuffer
+from apex_tpu._compat import axis_size as _axis_size
 
 
 class ShardedLambState(NamedTuple):
@@ -59,7 +60,7 @@ class DistributedFusedLAMB:
 
     def _world(self):
         try:
-            return jax.lax.axis_size(self.axis_name)
+            return _axis_size(self.axis_name)
         except NameError:
             return 1
 

@@ -1,0 +1,22 @@
+"""apex_tpu.lint — static analysis for TPU/JAX correctness invariants.
+
+Two layers (``docs/lint.md`` has the full catalog):
+
+- AST rules APX001-APX006 over the source tree (import-time jax work,
+  unknown collective axis names, PRNG key reuse, fp32 pins in
+  bf16-castable ops, side effects under jit, array default args);
+- jaxpr checks over traced programs (structural memory/dtype predicates
+  plus collective-axis consistency for registered entrypoints).
+
+CLI: ``python -m apex_tpu.lint [paths] [--json] [--jaxpr]``; suppress a
+finding inline with ``# apexlint: disable=APXnnn``.
+
+This package intentionally avoids importing jax at import time: the AST
+layer must be able to lint a tree whose jax is broken — that is its job.
+"""
+
+from apex_tpu.lint.core import (Finding, Rule, RULES, lint_paths,
+                                lint_source, register_rule)
+
+__all__ = ["Finding", "Rule", "RULES", "lint_paths", "lint_source",
+           "register_rule"]

@@ -1,0 +1,85 @@
+"""apexlint CLI: ``python -m apex_tpu.lint [paths] [--json] [--jaxpr]``.
+
+Exit status: 0 = clean, 1 = findings, 2 = usage/internal error — so the
+lint step slots into CI as-is (``scripts/lint.sh``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Optional, Sequence
+
+from apex_tpu.lint.core import lint_paths
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.lint",
+        description="Static analysis for TPU/JAX correctness invariants "
+                    "(AST rules APX001-APX006 + traced jaxpr checks).")
+    p.add_argument("paths", nargs="*", default=["apex_tpu"],
+                   help="files or directories to lint (default: apex_tpu)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable findings on stdout")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule codes to run (default: all)")
+    p.add_argument("--jaxpr", action="store_true",
+                   help="also trace the registered entrypoints and check "
+                        "collective-axis consistency (imports jax)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from apex_tpu.lint import rules_ast  # noqa: F401  (registers rules)
+    from apex_tpu.lint.core import RULES
+
+    if args.list_rules:
+        for code, rule in sorted(RULES.items()):
+            print(f"{code}  {rule.name}: {rule.description}")
+        return 0
+
+    select = ([c.strip() for c in args.select.split(",") if c.strip()]
+              if args.select else None)
+    missing = [p for p in args.paths if not Path(p).exists()]
+    if missing:
+        # a typo'd path must not read as "clean" — that would leave a CI
+        # gate permanently green while linting nothing
+        print(f"apexlint: error: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    findings = lint_paths(args.paths, select=select)
+
+    jaxpr_failures = {}
+    if args.jaxpr:
+        from apex_tpu.lint.jaxpr_checks import run_entrypoint_checks
+        jaxpr_failures = run_entrypoint_checks()
+
+    if args.as_json:
+        payload = {
+            "findings": [f.to_json() for f in findings],
+            "jaxpr_failures": {k: sorted(v) if isinstance(v, set) else v
+                               for k, v in jaxpr_failures.items()},
+        }
+        json.dump(payload, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        for f in findings:
+            print(f.format())
+        for name, bad in sorted(jaxpr_failures.items()):
+            print(f"entrypoint {name}: collective-axis check failed: {bad}")
+        total = len(findings) + len(jaxpr_failures)
+        print(f"apexlint: {total} finding(s)"
+              if total else "apexlint: clean")
+
+    return 1 if (findings or jaxpr_failures) else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

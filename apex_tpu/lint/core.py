@@ -1,0 +1,211 @@
+"""apexlint core: findings, the rule registry, suppressions, the runner.
+
+The linter has two analysis layers (see ``docs/lint.md``):
+
+- **AST rules** (this module drives them): pure-syntax checks over the
+  source tree, each registered under an ``APXnnn`` code via
+  :func:`register_rule`. They run with no jax import and no tracing, so
+  they catch the bug class that otherwise fails at *import* or *trace*
+  time — after CI has already burned minutes collecting.
+- **jaxpr checks** (``apex_tpu.lint.jaxpr_checks``): semantic checks over
+  traced programs, driven by the registered-entrypoint table.
+
+Suppressions are inline, pylint-style::
+
+    x = jnp.zeros((8,))  # apexlint: disable=APX001
+    y = risky()          # apexlint: disable=APX003,APX005
+    z = whatever()       # apexlint: disable
+
+A bare ``disable`` silences every rule on that physical line. The comment
+must sit on the line the finding anchors to (a multi-line statement
+anchors to its first line).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+import tokenize
+from pathlib import Path
+from typing import Callable, Iterable, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    code: str            # "APX001"
+    path: str            # file the finding is in
+    line: int            # 1-based line of the offending node
+    col: int             # 0-based column
+    message: str         # human explanation, specific to the site
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    code: str
+    name: str
+    description: str
+    check: Callable[["FileContext"], Iterable[Finding]]
+
+
+# code -> Rule; populated by register_rule (rules_ast registers APX001-006
+# on import; downstream packages may add their own)
+RULES: dict[str, Rule] = {}
+
+
+def register_rule(code: str, name: str, description: str):
+    """Decorator registering ``check(ctx) -> iterable[Finding]`` under
+    ``code``. Re-registering a code replaces the rule (tests use this)."""
+
+    def deco(fn):
+        RULES[code] = Rule(code=code, name=name, description=description,
+                           check=fn)
+        return fn
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# import-alias resolution shared by every AST rule
+# ---------------------------------------------------------------------------
+
+class ImportMap:
+    """Maps local names to canonical dotted paths from the file's imports.
+
+    ``import jax.numpy as jnp`` -> ``jnp`` = ``jax.numpy``;
+    ``from jax.experimental.pallas import tpu as pltpu`` -> ``pltpu`` =
+    ``jax.experimental.pallas.tpu``; ``from jax.lax import psum`` ->
+    ``psum`` = ``jax.lax.psum``. Star imports are ignored.
+    """
+
+    def __init__(self, tree: ast.Module):
+        self.aliases: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted path of a Name/Attribute chain, else None."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id, node.id)
+        return ".".join([root] + list(reversed(parts)))
+
+
+# ---------------------------------------------------------------------------
+# per-file context handed to each rule
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(r"#\s*apexlint:\s*disable(?:=([A-Z0-9,\s]+))?")
+
+
+class FileContext:
+    """Parsed file + shared analyses: one parse, N rules."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.imports = ImportMap(self.tree)
+        self.suppressions = _parse_suppressions(source)
+
+    def suppressed(self, finding: Finding) -> bool:
+        codes = self.suppressions.get(finding.line)
+        if codes is None:
+            return False
+        return codes == "all" or finding.code in codes
+
+
+def _parse_suppressions(source: str) -> dict[int, object]:
+    """line -> set of codes (or "all") from ``# apexlint: disable`` comments.
+
+    Tokenized, not regexed over raw lines, so a disable marker inside a
+    string literal does not suppress anything.
+    """
+    out: dict[int, object] = {}
+    try:
+        tokens = tokenize.generate_tokens(iter(source.splitlines(True)).__next__)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            if m.group(1) is None:
+                out[tok.start[0]] = "all"
+            else:
+                codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+                prev = out.get(tok.start[0])
+                if prev == "all":
+                    continue
+                out[tok.start[0]] = (prev or set()) | codes
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+def iter_source_files(paths: Iterable[str]) -> list[Path]:
+    files: list[Path] = []
+    for p in paths:
+        pp = Path(p)
+        if pp.is_dir():
+            files.extend(sorted(pp.rglob("*.py")))
+        elif pp.suffix == ".py":
+            files.append(pp)
+    return files
+
+
+def lint_source(path: str, source: str,
+                select: Optional[Iterable[str]] = None) -> list[Finding]:
+    """Run the registered AST rules over one source string."""
+    from apex_tpu.lint import rules_ast  # noqa: F401  (registers APX001-006)
+
+    try:
+        ctx = FileContext(path, source)
+    except SyntaxError as e:
+        return [Finding(code="APX000", path=path, line=e.lineno or 1,
+                        col=e.offset or 0,
+                        message=f"syntax error: {e.msg}")]
+    wanted = set(select) if select is not None else None
+    findings: list[Finding] = []
+    for code, rule in sorted(RULES.items()):
+        if wanted is not None and code not in wanted:
+            continue
+        for f in rule.check(ctx):
+            if not ctx.suppressed(f):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings
+
+
+def lint_paths(paths: Iterable[str],
+               select: Optional[Iterable[str]] = None) -> list[Finding]:
+    """Run the AST layer over files/directories."""
+    findings: list[Finding] = []
+    for f in iter_source_files(paths):
+        findings.extend(lint_source(str(f), f.read_text(encoding="utf-8"),
+                                    select=select))
+    return findings

@@ -1,0 +1,147 @@
+"""Registered traced entrypoints for the collective-consistency check.
+
+Each builder installs a small mesh (sized to whatever devices exist —
+the invariant is about axis *names*, which size-1 axes exercise just as
+well), returns a function plus tiny arguments, and
+``jaxpr_checks.run_entrypoint_checks`` traces it abstractly and asserts
+every collective's axis name is one the ambient mesh actually has. These
+are the programs apex_tpu ships as its hot paths: the amp-wrapped train
+step, the tensor-parallel layers, a pipeline schedule, and the fused
+LM-head loss — the places where an axis-name typo would otherwise trace
+clean and fail (or silently skip a reduction) on the pod.
+
+Importing this module registers the builders; it does no jax work itself
+(APX001 discipline).
+"""
+
+from __future__ import annotations
+
+from apex_tpu.lint.jaxpr_checks import register_entrypoint
+
+
+def _mesh_for(tp: int = 1, pp: int = 1):
+    """initialize_model_parallel sized down to the available devices."""
+    import jax
+    from apex_tpu.transformer import parallel_state as ps
+
+    world = len(jax.devices())
+    tp = tp if world % tp == 0 else 1
+    pp = pp if world % (tp * pp) == 0 else 1
+    ps.destroy_model_parallel()
+    mesh = ps.initialize_model_parallel(
+        tensor_model_parallel_size_=tp, pipeline_model_parallel_size_=pp)
+    return mesh, tp, pp
+
+
+def _amp_train_step():
+    """amp.make_train_step on a two-matmul model: the whole O1 hot loop
+    (scaled grad, unscale+overflow detect, conditional apply, scale
+    update) in one jitted program."""
+    import jax.numpy as jnp
+    from apex_tpu import amp
+    from apex_tpu.amp import scaler as scaler_mod
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer import parallel_state as ps
+
+    _mesh_for()
+
+    def loss_fn(params, x, y):
+        h = jnp.tanh(x @ params["w1"])
+        return jnp.mean((h @ params["w2"] - y) ** 2)
+
+    opt = FusedAdam(lr=1e-3)
+    step = amp.make_train_step(loss_fn, opt, donate=False)
+    params = {"w1": jnp.zeros((4, 8), jnp.float32),
+              "w2": jnp.zeros((8, 2), jnp.float32)}
+    opt_state = opt.init(params)
+    sstate = scaler_mod.init_state()
+    x = jnp.zeros((2, 4), jnp.float32)
+    y = jnp.zeros((2, 2), jnp.float32)
+    allowed = (ps.DATA_AXIS, ps.PIPELINE_AXIS, ps.TENSOR_AXIS,
+               ps.CONTEXT_AXIS, ps.EXPERT_AXIS)
+    return step, (params, opt_state, sstate, x, y), allowed
+
+
+def _tensor_parallel_layers():
+    """Column- then Row-parallel linear under shard_map over the tensor
+    axis — the f/g collectives of a Megatron block."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from apex_tpu._compat import shard_map
+    from apex_tpu.transformer import parallel_state as ps
+    from apex_tpu.transformer.tensor_parallel import (
+        ColumnParallelLinear, RowParallelLinear)
+
+    mesh, _, _ = _mesh_for(tp=2)
+    col = ColumnParallelLinear(input_size=8, output_size=16,
+                               gather_output=False)
+    row = RowParallelLinear(input_size=16, output_size=8,
+                            input_is_parallel=True)
+
+    def block(x):
+        vc = col.init(jax.random.PRNGKey(0), x)
+        h = col.apply(vc, x)
+        vr = row.init(jax.random.PRNGKey(1), h)
+        return row.apply(vr, h)
+
+    fn = shard_map(block, mesh=mesh, in_specs=(P(),), out_specs=P(),
+                   check_vma=False)
+    x = jnp.zeros((4, 8), jnp.float32)
+    return fn, (x,), mesh.axis_names
+
+
+def _pipeline_schedule():
+    """GPipe fill-drain over the pipeline axis (ppermute-based p2p)."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from apex_tpu._compat import shard_map
+    from apex_tpu.transformer.pipeline_parallel import pipeline_apply
+
+    mesh, _, _ = _mesh_for(pp=2)
+
+    def stage_fn(params, h):
+        return jnp.tanh(h * params)
+
+    def run(x, w):
+        return pipeline_apply(stage_fn, w, x, n_microbatches=2, remat=False)
+
+    fn = shard_map(run, mesh=mesh,
+                   in_specs=(P(), P("pipeline") if "pipeline" in
+                             mesh.axis_names and mesh.shape["pipeline"] > 1
+                             else P()),
+                   out_specs=P("pipeline"), check_vma=False)
+    x = jnp.zeros((2, 4, 4), jnp.float32)          # [n_micro, mb, d]
+    w = jnp.zeros((mesh.shape["pipeline"], 1), jnp.float32)[:, 0]
+    return fn, (x, w), mesh.axis_names
+
+
+def _fused_lm_head_ce():
+    """Vocab-parallel fused LM-head CE: the pmax/psum trio over the
+    tensor axis, plus the Pallas kernels in interpret mode."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from apex_tpu._compat import shard_map
+    from apex_tpu.ops.lm_head_ce import fused_lm_head_cross_entropy
+    from apex_tpu.transformer import parallel_state as ps
+
+    mesh, tp, _ = _mesh_for(tp=2)
+    v, h, n = 256, 32, 8
+
+    def loss(x, emb, tgt):
+        return jnp.sum(fused_lm_head_cross_entropy(
+            x, emb, tgt, axis_name=ps.TENSOR_AXIS, interpret=True))
+
+    fn = shard_map(loss, mesh=mesh,
+                   in_specs=(P(), P("tensor"), P()), out_specs=P(),
+                   check_vma=False)
+    x = jnp.zeros((n, h), jnp.float32)
+    emb = jnp.zeros((v, h), jnp.float32)
+    tgt = jnp.zeros((n,), jnp.int32)
+    return fn, (x, emb, tgt), mesh.axis_names
+
+
+register_entrypoint("amp_train_step", _amp_train_step)
+register_entrypoint("tensor_parallel_layers", _tensor_parallel_layers)
+register_entrypoint("pipeline_schedule", _pipeline_schedule)
+register_entrypoint("fused_lm_head_ce", _fused_lm_head_ce)

@@ -1,0 +1,193 @@
+"""apexlint layer 2: semantic checks over traced jaxprs.
+
+The AST layer sees syntax; this layer sees what XLA will actually run.
+The walker and the two structural predicates started life in
+``tests/jaxpr_utils.py`` (the memory/dtype test helpers) and are promoted
+here so library code, tests, and the CLI share one implementation
+(``tests/jaxpr_utils.py`` is now a thin re-export).
+
+On top of them sits the collective-consistency checker: TPU programs
+trace every collective into one XLA computation, so an axis name that
+does not exist in the ambient mesh fails at trace/lower time at best and
+at worst — with ``*_if_bound`` fallbacks like ``parallel_state``'s —
+silently skips the reduction. ``collective_axis_names`` extracts every
+axis named by a collective eqn anywhere in a jaxpr;
+``check_collective_axes`` asserts they all exist in an allowed set.
+Registered entrypoints (``apex_tpu.lint.entrypoints``) give the CLI and
+the tier-1 suite a curated list of real traced programs to hold to that
+invariant.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+# primitives that name a mesh axis, and the param key carrying the name(s)
+_COLLECTIVE_AXIS_PARAMS = {
+    "psum": "axes",
+    "pmax": "axes",
+    "pmin": "axes",
+    "ppermute": "axis_name",
+    "all_gather": "axis_name",
+    "all_to_all": "axis_name",
+    "reduce_scatter": "axis_name",
+    "psum_scatter": "axis_name",
+    "axis_index": "axis_name",
+}
+
+
+def _as_jaxpr(obj):
+    """Unwrap to a raw Jaxpr: ClosedJaxpr carries ``.jaxpr``; shard_map
+    and friends put a *raw* Jaxpr (``.eqns``) straight in their params."""
+    if hasattr(obj, "eqns"):
+        return obj
+    inner = getattr(obj, "jaxpr", None)
+    return inner if hasattr(inner, "eqns") else None
+
+
+def iter_eqns(jaxpr, *, skip_kernel_bodies: bool = False):
+    """Yield every eqn in ``jaxpr`` and, recursively, in any sub-jaxpr
+    reachable through eqn params (closed jaxprs, raw jaxprs — shard_map
+    bodies — and lists of either).
+
+    ``skip_kernel_bodies=True`` does not descend into ``pallas_call``
+    kernel jaxprs: their values live in VMEM under the kernel's own
+    block/budget accounting, so program-level assertions (HBM
+    intermediate sizes, XLA-level dot dtypes) must not see them — a
+    flash-attention kernel's in-VMEM logits *tile* scales with the block
+    size by design and is not an O(s^2) HBM intermediate.
+    """
+    for eqn in jaxpr.eqns:
+        yield eqn
+        if skip_kernel_bodies and eqn.primitive.name == "pallas_call":
+            continue
+        for sub in eqn.params.values():
+            subs = sub if isinstance(sub, (list, tuple)) else (sub,)
+            for s in subs:
+                inner = _as_jaxpr(s)
+                if inner is not None:
+                    yield from iter_eqns(
+                        inner, skip_kernel_bodies=skip_kernel_bodies)
+
+
+def max_intermediate_size(jaxpr) -> int:
+    """Largest output-variable element count anywhere in the program —
+    the memory-discipline assertion (no [s, s] score matrices etc.).
+    Pallas kernel bodies are excluded: in-VMEM tiles are block-sized by
+    construction and budgeted by the kernel, not HBM residents."""
+    sizes = [1]
+    for eqn in iter_eqns(jaxpr, skip_kernel_bodies=True):
+        for var in eqn.outvars:
+            shape = getattr(getattr(var, "aval", None), "shape", None)
+            if shape is not None:
+                sizes.append(int(np.prod(shape or (1,))))
+    return max(sizes)
+
+
+def dot_operand_dtypes(jaxpr):
+    """(lhs, rhs) dtypes of every dot_general — the autocast assertions.
+    XLA-level dots only (kernels pick their own accumulation dtypes)."""
+    return [tuple(iv.aval.dtype for iv in eqn.invars)
+            for eqn in iter_eqns(jaxpr, skip_kernel_bodies=True)
+            if eqn.primitive.name == "dot_general"]
+
+
+def collective_axis_names(jaxpr) -> set:
+    """Every string axis name any collective eqn in ``jaxpr`` (or its
+    sub-jaxprs) refers to. Positional (int) axes are not mesh axes and
+    are skipped."""
+    names: set = set()
+    for eqn in iter_eqns(jaxpr):
+        key = _COLLECTIVE_AXIS_PARAMS.get(eqn.primitive.name)
+        if key is None:
+            continue
+        axes = eqn.params.get(key)
+        if axes is None:
+            continue
+        if not isinstance(axes, (tuple, list)):
+            axes = (axes,)
+        for a in axes:
+            if isinstance(a, str):
+                names.add(a)
+    return names
+
+
+def check_collective_axes(jaxpr, allowed: Iterable[str]) -> set:
+    """Axis names used by collectives in ``jaxpr`` that are NOT in
+    ``allowed`` (empty set = consistent)."""
+    return collective_axis_names(jaxpr) - set(allowed)
+
+
+def trace_and_check(fn: Callable, *args,
+                    allowed: Optional[Iterable[str]] = None, **kwargs) -> set:
+    """Trace ``fn(*args, **kwargs)`` abstractly and return the set of
+    collective axis names missing from ``allowed`` (default: the
+    canonical ``parallel_state`` axis names)."""
+    import jax
+
+    if allowed is None:
+        from apex_tpu.transformer import parallel_state as ps
+        allowed = (ps.DATA_AXIS, ps.PIPELINE_AXIS, ps.TENSOR_AXIS,
+                   ps.CONTEXT_AXIS, ps.EXPERT_AXIS)
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    return check_collective_axes(closed.jaxpr, allowed)
+
+
+# ---------------------------------------------------------------------------
+# registered traced entrypoints
+# ---------------------------------------------------------------------------
+
+# name -> zero-arg builder returning (fn, args_tuple, allowed_axis_names).
+# The builder runs only at check time so registration costs nothing at
+# import (APX001 discipline applies to this module too).
+ENTRYPOINTS: dict = {}
+
+
+def register_entrypoint(name: str, builder: Callable):
+    """Register a traced entrypoint for the collective-consistency check.
+
+    ``builder()`` must return ``(fn, args, allowed_axis_names)`` —
+    ``fn(*args)`` is traced with ``jax.make_jaxpr`` (under whatever mesh
+    the builder installed) and every collective axis it names must be in
+    ``allowed_axis_names``. Keep the shapes tiny: the trace is abstract
+    but still pays compile-trace cost.
+    """
+    ENTRYPOINTS[name] = builder
+
+
+def run_entrypoint_checks(names: Optional[Iterable[str]] = None) -> dict:
+    """Run registered entrypoints; returns ``{name: problem}`` where
+    problem is a set of unknown axis names or an exception string. Empty
+    dict = all consistent. Importing ``apex_tpu.lint.entrypoints`` here
+    (not at module import) keeps the AST layer jax-free."""
+    import jax
+
+    from apex_tpu.lint import entrypoints as _ep  # noqa: F401 (registers)
+    from apex_tpu.transformer import parallel_state as ps
+
+    failures: dict = {}
+    wanted = set(names) if names is not None else None
+    # builders install their own model-parallel state; put ALL of the
+    # caller's back (mesh AND the virtual-pipeline/split-rank globals —
+    # destroy_model_parallel clears every one of them)
+    saved = (ps._MESH, ps._VIRTUAL_PIPELINE_WORLD_SIZE,
+             ps._VIRTUAL_PIPELINE_RANK, ps._PIPELINE_SPLIT_RANK)
+    try:
+        for name, builder in sorted(ENTRYPOINTS.items()):
+            if wanted is not None and name not in wanted:
+                continue
+            try:
+                fn, args, allowed = builder()
+                closed = jax.make_jaxpr(fn)(*args)
+                bad = check_collective_axes(closed.jaxpr, allowed)
+                if bad:
+                    failures[name] = bad
+            except Exception as e:  # builder/trace blew up: that IS a finding
+                failures[name] = f"{type(e).__name__}: {e}"
+    finally:
+        ps.destroy_model_parallel()
+        (ps._MESH, ps._VIRTUAL_PIPELINE_WORLD_SIZE,
+         ps._VIRTUAL_PIPELINE_RANK, ps._PIPELINE_SPLIT_RANK) = saved
+    return failures

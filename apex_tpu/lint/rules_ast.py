@@ -1,0 +1,456 @@
+"""apexlint AST rules APX001-APX006: TPU/JAX correctness invariants.
+
+Each rule targets a bug class that bites late on TPU — at import, at
+trace time, or silently in an XLA program — and moves the failure to a
+static pass. Registered via :func:`apex_tpu.lint.core.register_rule`; see
+``docs/lint.md`` for the catalog with rationale and examples.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, Optional
+
+from apex_tpu.lint.core import FileContext, Finding, register_rule
+
+# ---------------------------------------------------------------------------
+# shared vocabulary
+# ---------------------------------------------------------------------------
+
+
+# jax calls that are *lazy or registration-only* at import: they build no
+# arrays, touch no backend, and are stable across jax versions.
+_IMPORT_SAFE = frozenset({
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.grad", "jax.value_and_grad",
+    "jax.custom_vjp", "jax.custom_jvp", "jax.custom_gradient",
+    "jax.checkpoint", "jax.remat", "jax.named_call", "jax.ShapeDtypeStruct",
+})
+_IMPORT_SAFE_PREFIXES = ("jax.tree_util.", "jax.config.", "jax.typing.",
+                         "jax.sharding.PartitionSpec")
+
+_COLLECTIVES = {
+    # resolved path suffix -> index of the positional axis-name argument
+    "jax.lax.psum": 1, "jax.lax.pmean": 1, "jax.lax.pmax": 1,
+    "jax.lax.pmin": 1, "jax.lax.ppermute": 1, "jax.lax.all_gather": 1,
+    "jax.lax.all_to_all": 1, "jax.lax.psum_scatter": 1,
+    "jax.lax.axis_index": 0, "jax.lax.axis_size": 0,
+}
+
+# jax.random.* that mint or derive keys rather than consuming entropy.
+# fold_in is deliberately non-consuming: folding one key with distinct
+# data is the sanctioned way to derive many independent keys from it.
+_RANDOM_NONCONSUMING = frozenset({"PRNGKey", "key", "fold_in",
+                                  "wrap_key_data", "key_data", "clone"})
+
+_F32_NAMES = frozenset({"jax.numpy.float32", "jax.numpy.float64",
+                        "numpy.float32", "numpy.float64"})
+_F32_STRINGS = frozenset({"float32", "float64", "f32", "f64"})
+
+_JIT_WRAPPERS = frozenset({
+    "jax.jit", "jax.pmap",
+    "jax.experimental.shard_map.shard_map", "jax.shard_map",
+    "apex_tpu._compat.shard_map",
+})
+
+_ARRAY_CONSTRUCTORS = frozenset({
+    "array", "asarray", "zeros", "ones", "full", "empty", "arange",
+    "linspace", "eye", "zeros_like", "ones_like", "full_like",
+})
+
+
+def _canonical_axis_names() -> frozenset:
+    """Mesh axis names exported by parallel_state, with a static fallback
+    so the AST layer never *requires* importing jax."""
+    try:
+        from apex_tpu.transformer import parallel_state as ps
+        return frozenset({ps.DATA_AXIS, ps.PIPELINE_AXIS, ps.TENSOR_AXIS,
+                          ps.CONTEXT_AXIS, ps.EXPERT_AXIS})
+    except Exception:
+        return frozenset({"data", "pipeline", "tensor", "context", "expert"})
+
+
+def _bf16_castable_fragments() -> tuple:
+    """Lowercased name fragments of ops amp's O1 cast table declares
+    half-castable (the FP16_FUNCS analog), used by APX004 to decide which
+    functions must not pin fp32 dtypes."""
+    frags = {"dense", "einsum", "conv", "attention", "attn", "matmul",
+             "linear", "mlp"}
+    try:
+        from apex_tpu.amp import lists as _lists
+        for cls in _lists._HALF_MODULES:
+            frags.add(cls.__name__.lower())
+    except Exception:
+        pass
+    return tuple(sorted(frags, key=len, reverse=True))
+
+
+# ---------------------------------------------------------------------------
+# APX001 — import-time JAX/Pallas work
+# ---------------------------------------------------------------------------
+
+def _import_time_statements(tree: ast.Module) -> Iterator[ast.stmt]:
+    """Statements executed when the module is imported: the module body
+    plus nested non-function blocks (if/try/for/while/with, class bodies).
+    Function and lambda bodies run later; decorators and default
+    arguments also execute at import but are handled by their own rules
+    (decorators are jit-class wrappers = lazy; defaults are APX006)."""
+    stack: list[ast.stmt] = list(tree.body)
+    while stack:
+        stmt = stack.pop(0)
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for field in ("body", "orelse", "finalbody"):
+            stack.extend(getattr(stmt, field, []) or [])
+        for h in getattr(stmt, "handlers", []) or []:
+            stack.extend(h.body)
+
+
+@register_rule(
+    "APX001", "import-time-jax",
+    "module-level JAX/Pallas object construction or device computation")
+def check_import_time_jax(ctx: FileContext) -> Iterable[Finding]:
+    for stmt in _import_time_statements(ctx.tree):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Import, ast.ImportFrom)):
+            continue
+        for call in _stmt_own_calls(stmt):
+            path = ctx.imports.resolve(call.func)
+            if path is None:
+                continue
+            if path in _IMPORT_SAFE or path.startswith(_IMPORT_SAFE_PREFIXES):
+                continue
+            if path == "jax" or not path.startswith("jax."):
+                continue
+            # any other jax.* call at import time builds arrays, touches a
+            # backend, or (pallas) constructs version-fragile objects
+            if path.startswith("jax.") and "." not in path[4:]:
+                # bare jax.<name>: only flag the known backend-touching set
+                if path.split(".", 1)[1] not in {
+                        "devices", "local_devices", "device_count",
+                        "local_device_count", "device_put", "eval_shape",
+                        "make_mesh", "default_backend"}:
+                    continue
+            yield Finding(
+                code="APX001", path=ctx.path, line=call.lineno,
+                col=call.col_offset,
+                message=f"`{path}(...)` runs at module import time; build "
+                        "it lazily inside the function that uses it "
+                        "(an API rename or missing backend here breaks "
+                        "every importer at collection)")
+
+
+# ---------------------------------------------------------------------------
+# APX002 — collective axis-name literals
+# ---------------------------------------------------------------------------
+
+def _axis_arg(call: ast.Call, pos: int) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    if len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def _literal_axis_names(node: ast.expr) -> list[tuple[str, ast.expr]]:
+    """String constants in an axis-name expression (handles tuples/lists
+    of names). Non-literal expressions contribute nothing."""
+    out = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        out.append((node.value, node))
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            out.extend(_literal_axis_names(elt))
+    return out
+
+
+@register_rule(
+    "APX002", "unknown-collective-axis",
+    "collective call whose axis-name literal is not a canonical mesh axis")
+def check_collective_axis_literals(ctx: FileContext) -> Iterable[Finding]:
+    canonical = _canonical_axis_names()
+    for call in (n for n in ast.walk(ctx.tree) if isinstance(n, ast.Call)):
+        path = ctx.imports.resolve(call.func)
+        if path not in _COLLECTIVES:
+            continue
+        axis = _axis_arg(call, _COLLECTIVES[path])
+        if axis is None:
+            continue
+        for name, node in _literal_axis_names(axis):
+            if name not in canonical:
+                yield Finding(
+                    code="APX002", path=ctx.path, line=node.lineno,
+                    col=node.col_offset,
+                    message=f"axis name '{name}' is not a canonical mesh "
+                            f"axis ({', '.join(sorted(canonical))}); a typo "
+                            "here traces fine and fails (or silently "
+                            "no-ops) at run time — use the "
+                            "parallel_state.*_AXIS constants")
+
+
+# ---------------------------------------------------------------------------
+# APX003 — PRNG key reuse
+# ---------------------------------------------------------------------------
+
+def _random_consumer(ctx: FileContext, call: ast.Call) -> bool:
+    path = ctx.imports.resolve(call.func)
+    if not path or not path.startswith("jax.random."):
+        return False
+    return path.rsplit(".", 1)[1] not in _RANDOM_NONCONSUMING
+
+
+def _assigned_names(stmt: ast.stmt) -> set:
+    out = set()
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                for n in ast.walk(item.optional_vars):
+                    if isinstance(n, ast.Name):
+                        out.add(n.id)
+    return out
+
+
+def _stmt_own_calls(stmt: ast.stmt) -> Iterator[ast.Call]:
+    """Calls in the statement's own expressions, not in nested blocks or
+    nested function bodies (those are scanned as their own blocks)."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return
+    block_fields = {"body", "orelse", "finalbody", "handlers"}
+    stack = [v for f, v in ast.iter_fields(stmt) if f not in block_fields]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (list, tuple)):
+            stack.extend(n)
+            continue
+        if not isinstance(n, ast.AST) or isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _scan_block(ctx: FileContext, block: list, consumed: dict
+                ) -> Iterator[Finding]:
+    """Linear scan of one statement block. ``consumed`` maps key-variable
+    name -> line of its first consumption; nested blocks inherit a copy so
+    sibling branches (if/else) don't see each other's consumptions, while
+    use-after-use across nesting levels is still caught. Reassignment of
+    the name clears it (the split-and-rebind idiom)."""
+    for stmt in block:
+        for call in _stmt_own_calls(stmt):
+            if not _random_consumer(ctx, call):
+                continue
+            arg_names = [a.id for a in call.args if isinstance(a, ast.Name)]
+            arg_names += [kw.value.id for kw in call.keywords
+                          if isinstance(kw.value, ast.Name)
+                          and kw.arg in (None, "key", "seed")]
+            for name in arg_names:
+                if name in consumed:
+                    yield Finding(
+                        code="APX003", path=ctx.path, line=call.lineno,
+                        col=call.col_offset,
+                        message=f"PRNG key `{name}` was already consumed by "
+                                f"jax.random on line {consumed[name]}; "
+                                "reusing it makes the two draws correlated "
+                                "— jax.random.split it first")
+                else:
+                    consumed[name] = call.lineno
+        for name in _assigned_names(stmt):
+            consumed.pop(name, None)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue  # their bodies are scanned as their own scopes
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                yield from _scan_block(ctx, sub, dict(consumed))
+        for h in getattr(stmt, "handlers", []) or []:
+            yield from _scan_block(ctx, h.body, dict(consumed))
+
+
+@register_rule(
+    "APX003", "prng-key-reuse",
+    "the same PRNG key fed to two jax.random consumers without a split")
+def check_prng_key_reuse(ctx: FileContext) -> Iterable[Finding]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield from _scan_block(ctx, node.body, {})
+    yield from _scan_block(ctx, ctx.tree.body, {})
+
+
+# ---------------------------------------------------------------------------
+# APX004 — fp32 dtype literals in bf16-castable ops
+# ---------------------------------------------------------------------------
+
+def _is_fp32_literal(ctx: FileContext, node: ast.expr) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in _F32_STRINGS
+    path = ctx.imports.resolve(node)
+    return path in _F32_NAMES
+
+
+@register_rule(
+    "APX004", "fp32-in-castable-op",
+    "explicit float32/float64 dtype literal inside a bf16-castable op")
+def check_fp32_in_castable(ctx: FileContext) -> Iterable[Finding]:
+    frags = _bf16_castable_fragments()
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        lname = fn.name.lower()
+        if not any(f in lname for f in frags):
+            continue
+        for call in (n for n in ast.walk(fn) if isinstance(n, ast.Call)):
+            for kw in call.keywords:
+                # preferred_element_type=fp32 is the sanctioned MXU
+                # accumulation dtype, not a storage pin — only dtype= is
+                # a policy violation
+                if kw.arg != "dtype":
+                    continue
+                if _is_fp32_literal(ctx, kw.value):
+                    yield Finding(
+                        code="APX004", path=ctx.path, line=kw.value.lineno,
+                        col=kw.value.col_offset,
+                        message=f"`{fn.name}` is a bf16-castable op (amp O1 "
+                                "half list) but pins dtype="
+                                "float32/float64; take the dtype from the "
+                                "policy or inputs so autocast can apply "
+                                "(use preferred_element_type for fp32 "
+                                "accumulation)")
+
+
+# ---------------------------------------------------------------------------
+# APX005 — Python side effects under jit/shard_map/pmap
+# ---------------------------------------------------------------------------
+
+def _is_jit_decorator(ctx: FileContext, dec: ast.expr) -> bool:
+    if isinstance(dec, ast.Call):
+        path = ctx.imports.resolve(dec.func)
+        if path in _JIT_WRAPPERS:
+            return True
+        # functools.partial(jax.jit, ...) / partial(shard_map, mesh=...)
+        if path in ("functools.partial", "partial") and dec.args:
+            return ctx.imports.resolve(dec.args[0]) in _JIT_WRAPPERS
+        return False
+    return ctx.imports.resolve(dec) in _JIT_WRAPPERS
+
+
+def _local_bindings(fn: ast.FunctionDef) -> set:
+    bound = {a.arg for a in (fn.args.args + fn.args.posonlyargs
+                             + fn.args.kwonlyargs)}
+    if fn.args.vararg:
+        bound.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        bound.add(fn.args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                             ast.For, ast.AsyncFor, ast.withitem,
+                             ast.comprehension)):
+            tgt = getattr(node, "targets", None) or [
+                getattr(node, "target", None)
+                or getattr(node, "optional_vars", None)]
+            for t in tgt:
+                if t is None:
+                    continue
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        bound.add(n.id)
+    return bound
+
+
+@register_rule(
+    "APX005", "side-effect-under-jit",
+    "Python side effect inside a jit/shard_map/pmap-decorated function")
+def check_side_effects_under_jit(ctx: FileContext) -> Iterable[Finding]:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not any(_is_jit_decorator(ctx, d) for d in fn.decorator_list):
+            continue
+        local = _local_bindings(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                kind = ("global" if isinstance(node, ast.Global)
+                        else "nonlocal")
+                yield Finding(
+                    code="APX005", path=ctx.path, line=node.lineno,
+                    col=node.col_offset,
+                    message=f"`{kind} {', '.join(node.names)}` inside a "
+                            "traced function mutates Python state once at "
+                            "trace time, not per step")
+            elif isinstance(node, ast.Call):
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id == "print"):
+                    yield Finding(
+                        code="APX005", path=ctx.path, line=node.lineno,
+                        col=node.col_offset,
+                        message="print() inside a traced function runs once "
+                                "at trace time with tracers, not values — "
+                                "use jax.debug.print")
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in ("append", "extend", "insert")
+                      and isinstance(node.func.value, ast.Name)
+                      and node.func.value.id not in local):
+                    yield Finding(
+                        code="APX005", path=ctx.path, line=node.lineno,
+                        col=node.col_offset,
+                        message=f"`{node.func.value.id}.{node.func.attr}"
+                                "(...)` mutates a captured list inside a "
+                                "traced function: it runs once at trace "
+                                "time and leaks tracers")
+
+
+# ---------------------------------------------------------------------------
+# APX006 — mutable / array default arguments
+# ---------------------------------------------------------------------------
+
+def _bad_default(ctx: FileContext, node: ast.expr) -> Optional[str]:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return "mutable literal"
+    if isinstance(node, ast.Call):
+        path = ctx.imports.resolve(node.func)
+        if path is None:
+            return None
+        if path.startswith(("jax.numpy.", "jax.random.", "numpy.")):
+            tail = path.rsplit(".", 1)[1]
+            if (tail in _ARRAY_CONSTRUCTORS or path.startswith("jax.random.")):
+                return f"`{path}(...)`"
+    return None
+
+
+@register_rule(
+    "APX006", "array-default-arg",
+    "mutable or jnp.array default argument")
+def check_array_defaults(ctx: FileContext) -> Iterable[Finding]:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            continue
+        defaults = list(fn.args.defaults) + [
+            d for d in fn.args.kw_defaults if d is not None]
+        for d in defaults:
+            what = _bad_default(ctx, d)
+            if what:
+                name = getattr(fn, "name", "<lambda>")
+                yield Finding(
+                    code="APX006", path=ctx.path, line=d.lineno,
+                    col=d.col_offset,
+                    message=f"default argument of `{name}` is {what}: it is "
+                            "evaluated once at import (APX001 hazard, "
+                            "device allocation before backend choice) and "
+                            "shared across calls — default to None and "
+                            "build it in the body")

@@ -65,6 +65,7 @@ from apex_tpu.models.gpt import GPT, GPTBlock, GPTConfig, moe_aux_sum
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.ops.lm_head_ce import fused_lm_head_cross_entropy
 from apex_tpu.transformer import parallel_state as ps
+from apex_tpu._compat import axis_size as _axis_size
 from apex_tpu.transformer.pipeline_parallel.schedules import (
     forward_backward_pipelining_1f1b_interleaved_model,
     forward_backward_pipelining_1f1b_model, pipeline_apply_interleaved,
@@ -285,7 +286,7 @@ class PipelinedGPT:
             labels_mb.reshape(nmb * mb, s))
         loss = jnp.mean(losses)
         rank = jax.lax.axis_index(self.axis_name)
-        n_stages = jax.lax.axis_size(self.axis_name)
+        n_stages = _axis_size(self.axis_name)
         loss = jnp.where(rank == n_stages - 1, loss, 0.0)
         if aux is not None:
             # each rank's aux covers ITS executed (stage, microbatch)

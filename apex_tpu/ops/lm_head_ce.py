@@ -50,8 +50,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu._compat import tpu_compiler_params
 from apex_tpu.ops.flash_attention import _resolve_interpret
 from apex_tpu.transformer import parallel_state as ps
 
@@ -69,7 +69,13 @@ _NEG_INF = -1e30
 # tiles valid in every shipping context with headroom for the
 # compiler's own buffers.
 _VMEM_LIMIT = 64 * 1024 * 1024
-_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+
+
+def _compiler_params():
+    # resolved at call time: the params class name drifted across jax
+    # releases (CompilerParams vs TPUCompilerParams) and constructing it
+    # at import broke every importer on the other side of the rename
+    return tpu_compiler_params(vmem_limit_bytes=_VMEM_LIMIT)
 
 
 def _ceil_to(x: int, m: int) -> int:
@@ -207,7 +213,7 @@ def _fwd_partials(x, e, tgt_local, block_t, block_v, v_local, interpret,
             pl.BlockSpec((1, 1, block_t), lambda v, t: (v, 0, t))] * n_out,
         out_shape=[jax.ShapeDtypeStruct((n_vb, 1, n), jnp.float32)] * n_out,
         interpret=interpret,
-        compiler_params=_COMPILER_PARAMS,
+        compiler_params=_compiler_params(),
     )(x, e, tgt_local)
     m, l, pred = (a[:, 0] for a in outs[:3])
     # combine the per-vocab-block online-softmax partials (tiny: [n_vb, n])
@@ -279,7 +285,7 @@ def _fused_ce_bwd(label_smoothing, axis_name, block_t, block_v, v_local,
             jax.ShapeDtypeStruct((n_vb, n, h), x.dtype),
         ],
         interpret=interpret,
-        compiler_params=_COMPILER_PARAMS,
+        compiler_params=_compiler_params(),
     )(x, ec, tgt, m_g[None], l_g[None],
       dloss.astype(jnp.float32)[None])
     # e arrives padded to a block multiple (see wrapper); the pad's own

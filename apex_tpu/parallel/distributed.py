@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from apex_tpu.utils.flat import flatten_tensors, unflatten_tensors
 from apex_tpu.utils.parity import warn_inert_once as _warn_inert_once
+from apex_tpu._compat import axis_size as _axis_size
 
 
 def allreduce_gradients(
@@ -44,7 +45,7 @@ def allreduce_gradients(
     """psum a gradient pytree over ``axis_name`` with apex's scaling options
     (``apex/parallel/distributed.py:425-468`` allreduce_bucket +
     allreduce_maybe_retain)."""
-    world = jax.lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
 
     def _one(g):
         if not jnp.issubdtype(g.dtype, jnp.floating):
@@ -151,7 +152,7 @@ class Reducer:
         self.axis_name = axis_name
 
     def reduce(self, tree):
-        world = jax.lax.axis_size(self.axis_name)
+        world = _axis_size(self.axis_name)
         return jax.tree.map(
             lambda g: jax.lax.psum(g, self.axis_name) / world
             if jnp.issubdtype(g.dtype, jnp.floating) else g, tree)

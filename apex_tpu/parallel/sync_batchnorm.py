@@ -23,6 +23,7 @@ from typing import Any, Callable, Optional, Sequence
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from apex_tpu._compat import axis_size as _axis_size
 
 
 def create_syncbn_process_group(group_size: int, world_size: int):
@@ -48,7 +49,7 @@ def _grouped_psum(x, axis_name, groups):
     """
     if groups is None:
         return jax.lax.psum(x, axis_name)
-    world = jax.lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     gathered = jax.lax.all_gather(x, axis_name)          # [world, ...]
     import numpy as np
     m = np.zeros((world, world), np.float32)
@@ -106,7 +107,7 @@ class SyncBatchNorm(nn.Module):
             in_mapped_ctx = True
             if self.axis_name is not None:
                 try:
-                    jax.lax.axis_size(self.axis_name)
+                    _axis_size(self.axis_name)
                 except NameError:
                     in_mapped_ctx = False  # e.g. Module.init outside shard_map
             if self.axis_name is not None and in_mapped_ctx:

@@ -27,6 +27,7 @@ from typing import Optional, Sequence
 import numpy as np
 import jax
 from jax.sharding import Mesh
+from apex_tpu._compat import axis_size as _traced_axis_size
 
 # Canonical axis names
 DATA_AXIS = "data"
@@ -179,11 +180,17 @@ def sequence_parallel_active(flag: bool) -> bool:
 
 
 def axis_size_if_bound(axis_name) -> int:
-    """Size of ``axis_name`` inside shard_map, 1 when unbound/None."""
+    """Size of ``axis_name`` inside shard_map, 1 when unbound/None.
+
+    Reads the *traced axis env* (the compat ``axis_size``), not the
+    static ``_MESH`` lookup ``_axis_size`` above: callers may be inside a
+    shard_map over a mesh that was never installed as the global, and
+    outside any shard_map the axis is unbound (NameError -> 1) even when
+    a global mesh with that axis exists."""
     if axis_name is None:
         return 1
     try:
-        return jax.lax.axis_size(axis_name)
+        return _traced_axis_size(axis_name)
     except NameError:
         return 1
 

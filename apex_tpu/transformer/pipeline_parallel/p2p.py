@@ -11,6 +11,7 @@ from __future__ import annotations
 import jax
 
 from apex_tpu.transformer import parallel_state as ps
+from apex_tpu._compat import axis_size as _axis_size
 
 
 def ring_shift(x, axis_name: str = ps.PIPELINE_AXIS, reverse: bool = False,
@@ -18,7 +19,7 @@ def ring_shift(x, axis_name: str = ps.PIPELINE_AXIS, reverse: bool = False,
     """Shift ``x`` one stage forward (rank i → i+1), or backward with
     ``reverse``. ``wrap=False`` leaves the edge stage receiving zeros
     (ppermute semantics for unlisted destinations)."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if n == 1:
         return x
     if reverse:

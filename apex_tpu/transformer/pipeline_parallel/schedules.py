@@ -25,6 +25,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from apex_tpu._compat import axis_size as _axis_size
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.microbatches import resolve_num_microbatches
 from apex_tpu.transformer.pipeline_parallel.p2p import (
@@ -44,7 +45,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
     ``n_microbatches`` may be an int or a ``NumMicroBatchesCalculator``.
     """
     n_microbatches = resolve_num_microbatches(n_microbatches)
-    n_stages = jax.lax.axis_size(axis_name)
+    n_stages = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     total_ticks = n_microbatches + n_stages - 1
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
@@ -103,7 +104,7 @@ def forward_backward_pipelining_without_interleaving(
     grads is exact). Runs inside shard_map over the pipeline axis.
     """
     n_microbatches = resolve_num_microbatches(n_microbatches)
-    n_stages = jax.lax.axis_size(axis_name)
+    n_stages = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
 
     def full(params):
@@ -279,7 +280,7 @@ def forward_backward_pipelining_1f1b_model(
     schedule — peak activations constant in ``n_microbatches``.
     """
     n_microbatches = resolve_num_microbatches(n_microbatches)
-    n_stages = jax.lax.axis_size(axis_name)
+    n_stages = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     is_last = rank == n_stages - 1
     is_first = rank == 0
@@ -408,7 +409,7 @@ def forward_backward_pipelining_1f1b_interleaved_model(
     ``n_microbatches % P == 0`` (the Megatron interleaving constraint).
     """
     n_microbatches = resolve_num_microbatches(n_microbatches)
-    n_stages = jax.lax.axis_size(axis_name)
+    n_stages = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     V = n_chunks
     P = n_stages
@@ -622,7 +623,7 @@ def pipeline_apply_interleaved(stage_fn: Callable, chunk_params, x,
     and microbatches.
     """
     n_microbatches = resolve_num_microbatches(n_microbatches)
-    n_stages = jax.lax.axis_size(axis_name)
+    n_stages = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     V = n_chunks
     lead = {leaf.shape[0] for leaf in jax.tree_util.tree_leaves(chunk_params)}
@@ -696,7 +697,7 @@ def forward_backward_pipelining_with_interleaving(
     microbatch axis needs an external ``/ (n_microbatches // G)``.
     """
     n_microbatches = resolve_num_microbatches(n_microbatches)
-    n_stages = jax.lax.axis_size(axis_name)
+    n_stages = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     if n_chunks is None:
         n_chunks = ps.get_virtual_pipeline_model_parallel_world_size() or 1

@@ -48,6 +48,7 @@ import jax.numpy as jnp
 from apex_tpu.ops.flash_attention import (
     _flash_bwd_impl, _flash_fwd_impl, _resolve_interpret)
 from apex_tpu.transformer import parallel_state as ps
+from apex_tpu._compat import axis_size as _axis_size
 
 _NEG_INF = -1e30
 
@@ -78,7 +79,7 @@ def _merge(out, lse, o_s, l_s):
 
 
 def _ring_layout(axis_name):
-    cp = jax.lax.axis_size(axis_name)
+    cp = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % cp) for i in range(cp)]
     return cp, rank, perm
@@ -267,7 +268,7 @@ def ulysses_attention(q, k, v, axis_name: str = ps.CONTEXT_AXIS,
     seed internally — the kernel hashes the LOCAL head index, so without
     the fold every rank's head shard would repeat the same masks.
     """
-    cp = jax.lax.axis_size(axis_name)
+    cp = _axis_size(axis_name)
     b, h, s_local, d = q.shape
     if h % cp:
         raise ValueError(f"num heads {h} must be divisible by cp {cp}")

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.transformer import parallel_state as ps
+from apex_tpu._compat import axis_size as _axis_size
 
 
 # -- copy_to: identity / psum ------------------------------------------------
@@ -66,7 +67,7 @@ reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
 # -- scatter_to: local split / all-gather -----------------------------------
 
 def _local_chunk(x, axis_name, dim=-1):
-    world = jax.lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     size = x.shape[dim] // world
     return jax.lax.dynamic_slice_in_dim(x, rank * size, size, axis=dim)

@@ -829,7 +829,7 @@ def _bench_ring_s32k():
     # shard_map makes cp=1 real (the ring collectives become no-op
     # self-permutes, which is exactly the kernel-path overhead to price)
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    from apex_tpu._compat import shard_map
     # parallel_state only materializes the context axis at cp>1; bind a
     # 1-device context mesh directly so the ring collectives run
     mesh = Mesh(np.array(jax.devices()[:1]), (ps.CONTEXT_AXIS,))

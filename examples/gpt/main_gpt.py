@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from apex_tpu._compat import shard_map
 
 from apex_tpu.amp import scaler as scaler_mod
 from apex_tpu.models import GPT, GPTConfig

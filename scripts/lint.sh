@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# CI lint step: one linter run, JSON to an artifact, human-readable
+# summary rendered from the artifact. Exits nonzero iff the linter found
+# anything (or errored), so it gates a PR as-is.
+#
+#   scripts/lint.sh [paths...]            # default: apex_tpu
+#   LINT_ARTIFACT=out.json scripts/lint.sh
+#   LINT_JAXPR=1 scripts/lint.sh          # also run the traced-entrypoint
+#                                         # collective-consistency checks
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+ARTIFACT="${LINT_ARTIFACT:-lint_report.json}"
+PATHS=("${@:-apex_tpu}")
+EXTRA=()
+if [[ "${LINT_JAXPR:-0}" == "1" ]]; then
+  EXTRA+=(--jaxpr)
+fi
+
+# CPU is all the linter needs; 8 virtual devices let the jaxpr-layer
+# entrypoints build real multi-axis meshes (same trick as tests/conftest).
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+
+# single run: the jaxpr entrypoint traces are the expensive part
+python -m apex_tpu.lint "${PATHS[@]}" "${EXTRA[@]}" --json > "$ARTIFACT"
+rc=$?
+
+python - "$ARTIFACT" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+for f in d["findings"]:
+    print(f"{f['path']}:{f['line']}:{f['col']}: {f['code']} {f['message']}")
+for name, bad in sorted(d["jaxpr_failures"].items()):
+    print(f"entrypoint {name}: collective-axis check failed: {bad}")
+n = len(d["findings"]) + len(d["jaxpr_failures"])
+print(f"apexlint: {n} finding(s)" if n else "apexlint: clean")
+EOF
+
+echo "lint report: $ARTIFACT"
+exit $rc

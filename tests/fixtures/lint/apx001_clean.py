@@ -1,0 +1,21 @@
+"""APX001 fixture: the same objects built lazily — clean."""
+import functools
+
+import jax
+
+import jax.numpy as jnp
+from apex_tpu._compat import tpu_compiler_params
+
+
+@functools.lru_cache(maxsize=None)
+def _params():
+    return tpu_compiler_params(vmem_limit_bytes=1)
+
+
+def table():
+    return jnp.arange(8)
+
+
+@jax.custom_vjp
+def f(x):
+    return x

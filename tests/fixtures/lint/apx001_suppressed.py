@@ -1,0 +1,4 @@
+"""APX001 fixture: violation acknowledged inline."""
+import jax.numpy as jnp
+
+_TABLE = jnp.arange(8)  # apexlint: disable=APX001

@@ -1,0 +1,6 @@
+"""APX001 fixture: module-level Pallas/JAX construction (the seed bug)."""
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=1)
+_TABLE = jnp.arange(8)

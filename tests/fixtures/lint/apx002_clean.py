@@ -1,0 +1,16 @@
+"""APX002 fixture: canonical literals and non-literal axis args — clean."""
+import jax
+
+from apex_tpu.transformer import parallel_state as ps
+
+
+def reduce_grads(g):
+    return jax.lax.psum(g, "tensor")
+
+
+def reduce_over(x, axis_name):
+    return jax.lax.psum(x, axis_name)
+
+
+def reduce_const(x):
+    return jax.lax.pmean(x, ps.DATA_AXIS)

@@ -1,0 +1,6 @@
+"""APX002 fixture: deliberately non-canonical axis, acknowledged."""
+import jax
+
+
+def reduce_grads(g):
+    return jax.lax.psum(g, "my_axis")  # apexlint: disable=APX002

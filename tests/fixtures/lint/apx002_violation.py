@@ -1,0 +1,10 @@
+"""APX002 fixture: axis-name typo in a collective."""
+import jax
+
+
+def reduce_grads(g):
+    return jax.lax.psum(g, "tensro")
+
+
+def gather(x):
+    return jax.lax.all_gather(x, axis_name="pipe_line")

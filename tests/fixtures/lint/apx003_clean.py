@@ -1,0 +1,23 @@
+"""APX003 fixture: split-and-rebind, fold_in derivation, branches — clean."""
+import jax
+
+
+def sample(key):
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, (2,))
+    key, sub = jax.random.split(key)
+    b = jax.random.uniform(sub, (2,))
+    return a + b
+
+
+def derive(key, i):
+    ka = jax.random.fold_in(key, 2 * i)
+    kb = jax.random.fold_in(key, 2 * i + 1)
+    return jax.random.normal(ka, (2,)) + jax.random.normal(kb, (2,))
+
+
+def branchy(key, flag):
+    if flag:
+        return jax.random.normal(key, (2,))
+    else:
+        return jax.random.uniform(key, (2,))

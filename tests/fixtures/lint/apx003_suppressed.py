@@ -1,0 +1,8 @@
+"""APX003 fixture: intentional reuse (correlated draws), acknowledged."""
+import jax
+
+
+def antithetic(key):
+    a = jax.random.normal(key, (2,))
+    b = -jax.random.normal(key, (2,))  # apexlint: disable=APX003
+    return a, b

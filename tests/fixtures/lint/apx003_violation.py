@@ -1,0 +1,8 @@
+"""APX003 fixture: one key, two draws."""
+import jax
+
+
+def sample(key):
+    a = jax.random.normal(key, (2,))
+    b = jax.random.uniform(key, (2,))
+    return a + b

@@ -1,0 +1,16 @@
+"""APX004 fixture: dtype follows inputs; fp32 accumulation via
+preferred_element_type; fp32 in a non-castable op — all clean."""
+import jax
+import jax.numpy as jnp
+
+
+def fused_dense_apply(x, w):
+    bias = jnp.zeros((4,), dtype=x.dtype)
+    y = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    return y + bias
+
+
+def loss_reduction(x):
+    acc = jnp.zeros((), dtype=jnp.float32)
+    return acc + jnp.sum(x)

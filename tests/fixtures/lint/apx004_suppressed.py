@@ -1,0 +1,6 @@
+"""APX004 fixture: deliberate fp32 master copy inside a castable op."""
+import jax.numpy as jnp
+
+
+def dense_master_weights(w):
+    return jnp.asarray(w, dtype=jnp.float32)  # apexlint: disable=APX004

@@ -1,0 +1,7 @@
+"""APX004 fixture: fp32 pinned inside a bf16-castable op."""
+import jax.numpy as jnp
+
+
+def fused_dense_apply(x, w):
+    bias = jnp.zeros((4,), dtype=jnp.float32)
+    return x @ w + bias

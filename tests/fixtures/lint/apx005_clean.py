@@ -1,0 +1,15 @@
+"""APX005 fixture: jax.debug.print and local accumulation — clean."""
+import jax
+
+
+@jax.jit
+def step(x):
+    jax.debug.print("x = {}", x)
+    outs = []
+    outs.append(x * 2)
+    return outs[0]
+
+
+def helper(x):
+    print("not traced", x)
+    return x

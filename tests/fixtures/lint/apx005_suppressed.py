@@ -1,0 +1,8 @@
+"""APX005 fixture: trace-time print kept on purpose (debug aid)."""
+import jax
+
+
+@jax.jit
+def step(x):
+    print("retrace!", x.shape)  # apexlint: disable=APX005
+    return x * 2

@@ -1,0 +1,11 @@
+"""APX005 fixture: Python side effects under jit."""
+import jax
+
+_TRACE_LOG = []
+
+
+@jax.jit
+def step(x):
+    print("tracing", x)
+    _TRACE_LOG.append(x)
+    return x * 2

@@ -1,0 +1,14 @@
+"""APX006 fixture: None defaults built in the body — clean."""
+import jax.numpy as jnp
+
+
+def shift(x, offset=None):
+    if offset is None:
+        offset = jnp.zeros((3,))
+    return x + offset
+
+
+def collect(x, acc=None):
+    acc = [] if acc is None else acc
+    acc.append(x)
+    return acc

@@ -1,0 +1,6 @@
+"""APX006 fixture: module-lifetime constant default, acknowledged."""
+import jax.numpy as jnp
+
+
+def shift(x, offset=jnp.zeros((3,))):  # apexlint: disable=APX006,APX001
+    return x + offset

@@ -1,0 +1,11 @@
+"""APX006 fixture: array and mutable defaults."""
+import jax.numpy as jnp
+
+
+def shift(x, offset=jnp.zeros((3,))):
+    return x + offset
+
+
+def collect(x, acc=[]):
+    acc.append(x)
+    return acc

@@ -1,45 +1,7 @@
-"""Shared jaxpr introspection helpers for the memory/dtype tests.
+"""Thin re-export: the jaxpr introspection helpers were promoted into
+``apex_tpu.lint.jaxpr_checks`` (the linter's layer 2) so library code,
+tests, and the CLI share one walker. Import from there in new code."""
 
-Several tests assert structural properties of traced programs (largest
-intermediate size, matmul operand dtypes); they all need the same
-recursive walk over a jaxpr and its sub-jaxprs (cond branches, scan
-bodies, custom-vjp calls...). One walker here instead of a copy per
-test file.
-"""
-
-from __future__ import annotations
-
-import numpy as np
-
-
-def iter_eqns(jaxpr):
-    """Yield every eqn in ``jaxpr`` and, recursively, in any sub-jaxpr
-    reachable through eqn params (closed jaxprs and lists of them)."""
-    for eqn in jaxpr.eqns:
-        yield eqn
-        for sub in eqn.params.values():
-            if hasattr(sub, "jaxpr"):
-                yield from iter_eqns(sub.jaxpr)
-            if isinstance(sub, (list, tuple)):
-                for s in sub:
-                    if hasattr(s, "jaxpr"):
-                        yield from iter_eqns(s.jaxpr)
-
-
-def max_intermediate_size(jaxpr) -> int:
-    """Largest output-variable element count anywhere in the program —
-    the memory-discipline assertion (no [s, s] score matrices etc.)."""
-    sizes = [1]
-    for eqn in iter_eqns(jaxpr):
-        for var in eqn.outvars:
-            shape = getattr(getattr(var, "aval", None), "shape", None)
-            if shape is not None:
-                sizes.append(int(np.prod(shape or (1,))))
-    return max(sizes)
-
-
-def dot_operand_dtypes(jaxpr):
-    """(lhs, rhs) dtypes of every dot_general — the autocast assertions."""
-    return [tuple(iv.aval.dtype for iv in eqn.invars)
-            for eqn in iter_eqns(jaxpr)
-            if eqn.primitive.name == "dot_general"]
+from apex_tpu.lint.jaxpr_checks import (  # noqa: F401
+    collective_axis_names, dot_operand_dtypes, iter_eqns,
+    max_intermediate_size)

@@ -243,7 +243,7 @@ class _PlainFlaxNet(nn.Module):
 
 
 def _collect_dots(fn, *args):
-    from tests.jaxpr_utils import dot_operand_dtypes
+    from apex_tpu.lint.jaxpr_checks import dot_operand_dtypes
     return dot_operand_dtypes(jax.make_jaxpr(fn)(*args).jaxpr)
 
 

@@ -145,7 +145,7 @@ def test_rnn_o1_autocast_casts_matmuls():
         with autocast(True, jnp.bfloat16):
             return cell(p, carry, x)
 
-    from tests.jaxpr_utils import dot_operand_dtypes
+    from apex_tpu.lint.jaxpr_checks import dot_operand_dtypes
     dots = dot_operand_dtypes(jax.make_jaxpr(run)(p, carry, x).jaxpr)
     assert dots and all(d == (jnp.bfloat16, jnp.bfloat16) for d in dots)
 

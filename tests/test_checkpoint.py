@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from apex_tpu._compat import shard_map
 
 from apex_tpu import checkpoint as ckpt
 from apex_tpu.amp import scaler as scaler_mod

@@ -6,10 +6,11 @@ steps), and the spatial-parallel bottleneck correctness.
 """
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from apex_tpu._compat import shard_map
 
 from apex_tpu.contrib.transducer import transducer_joint, transducer_loss
 from apex_tpu.contrib.sparsity import ASP, create_mask
@@ -155,6 +156,7 @@ def test_halo_exchange():
             assert (y[r, 3] == 0).all()
 
 
+@pytest.mark.slow
 def test_spatial_bottleneck_matches_unsharded():
     mesh = Mesh(np.array(jax.devices()), ("data",))
     n = len(jax.devices())

@@ -8,10 +8,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from apex_tpu._compat import shard_map
 
 from apex_tpu.contrib.optimizers import DistributedFusedAdam, DistributedFusedLAMB
 from apex_tpu.optimizers import FusedAdam, FusedLAMB
+from apex_tpu._compat import axis_size as _axis_size
 
 
 def _params(seed=0, sizes=((5, 3), (7,), (2, 2, 2))):
@@ -34,7 +35,7 @@ def _sharded_steps(opt, params, grads_list):
         for g in grads_list:
             # replicated grads: each rank contributes g/world so the
             # reduce-scatter sum reconstructs g
-            world = jax.lax.axis_size("data")
+            world = _axis_size("data")
             cur, state = opt.apply(state, cur, jax.tree.map(lambda x: x / world, g))
         return cur
 

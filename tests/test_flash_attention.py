@@ -383,7 +383,7 @@ def test_flash_backward_memory_flat_in_seqlen():
         def f(q, k, v):
             return jnp.sum(flash_attention(q, k, v, causal=True))
 
-        from tests.jaxpr_utils import max_intermediate_size
+        from apex_tpu.lint.jaxpr_checks import max_intermediate_size
         return max_intermediate_size(
             jax.make_jaxpr(jax.grad(f, (0, 1, 2)))(q, k, v).jaxpr)
 

@@ -100,6 +100,7 @@ class TestDCGAN:
 
 @pytest.mark.parametrize("remat,policy", [(False, None), (True, None),
                                           (True, "dots")])
+@pytest.mark.slow
 def test_gpt_remat_matches(remat, policy):
     """jax.checkpoint'd blocks are numerically identical (full recompute
     and the save-dots selective policy); grads too."""
@@ -149,6 +150,7 @@ def test_gpt_flash_vs_fused_softmax_path():
     assert "pallas_call" not in jaxpr_dbg
 
 
+@pytest.mark.slow
 def test_gpt_dropout():
     """attention_dropout runs in-kernel (flash) and hidden_dropout on the
     residual branches; deterministic application stays the default."""
@@ -198,6 +200,7 @@ def test_gpt_dropout_with_remat():
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("moe", [False, True])
 def test_gpt_loss_fused_lm_head_matches_unfused(moe):
     """``GPTConfig.fused_lm_head`` (Pallas logits+CE, no [b,s,V] in HBM)

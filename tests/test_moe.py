@@ -2,10 +2,11 @@
 the single-device dense computation with the same global weights."""
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from apex_tpu._compat import shard_map
 
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.moe import (ExpertParallelMLP, expert_parallel_mlp,
@@ -65,6 +66,7 @@ def test_expert_parallel_matches_single_device():
     ps.destroy_model_parallel()
 
 
+@pytest.mark.slow
 def test_expert_parallel_grads_match():
     mesh = _setup(ep=4)
     h, f, E, t = 8, 16, 4, 32
@@ -144,6 +146,7 @@ def test_top2_routing_contract():
     assert float(aux) > 0
 
 
+@pytest.mark.slow
 def test_top2_expert_parallel_matches_single_device():
     """ep=4 top-2 (all_to_all dispatch/return) == ep=1 with the same
     weights, values and gradients."""
@@ -194,6 +197,7 @@ def test_top2_beats_top1_capacity_utilization():
     assert float(d2.sum()) > float(d1.sum())
 
 
+@pytest.mark.slow
 def test_gpt_moe_trains_single_device():
     """GPT with MoE blocks (top-2, every other layer): loss decreases and
     the aux loss contributes (unbound expert axis = dense MoE)."""
@@ -274,6 +278,7 @@ def test_gpt_moe_expert_parallel_step():
     ps.destroy_model_parallel()
 
 
+@pytest.mark.slow
 def test_routing_health_at_bench_shape():
     """Stats-contract guard (VERDICT r4 weak #4): with UNCORRELATED
     (iid Gaussian) inputs at the bench token/expert/capacity shape
@@ -328,6 +333,7 @@ def test_gpt_sows_moe_drop_frac():
     assert np.isfinite(float(aux))
 
 
+@pytest.mark.slow
 def test_aux_loss_balances_routing_under_training():
     """The mechanism behind the bench's routing-health trend: training
     with the load-balancing aux reduces the capacity-drop fraction (the

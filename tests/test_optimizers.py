@@ -296,7 +296,7 @@ def test_lamb_tp2_matches_tp1(max_grad_norm):
     tensor_parallel/layers.py:47-57 dedup). tp=2 shard updates must
     equal slices of the tp=1 update, including when clipping engages."""
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    from apex_tpu._compat import shard_map
     from apex_tpu.optimizers import FusedLAMB
 
     rng = np.random.RandomState(0)
@@ -347,7 +347,7 @@ def test_novograd_tp2_matches_tp1():
     """NovoGrad's per-tensor scalar second moment is the logical-tensor
     grad norm under tp (L2 psum of shard partials)."""
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    from apex_tpu._compat import shard_map
     from apex_tpu.optimizers import FusedNovoGrad
 
     rng = np.random.RandomState(1)

@@ -10,7 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from apex_tpu._compat import shard_map
 
 from apex_tpu.parallel import (
     allreduce_gradients, DistributedDataParallel, SyncBatchNorm,

@@ -73,7 +73,7 @@ def test_sync_found_inf_across_tp():
     agree on skip-vs-apply (one rank's inf flags the whole group)."""
     import jax
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from apex_tpu._compat import shard_map
     from apex_tpu.transformer import parallel_state as ps
 
     ps.destroy_model_parallel()
