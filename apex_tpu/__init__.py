@@ -41,6 +41,7 @@ from apex_tpu import mlp  # noqa: F401
 from apex_tpu import fp16_utils  # noqa: F401
 from apex_tpu import reparameterization  # noqa: F401
 from apex_tpu import rnn  # noqa: F401
+from apex_tpu import monitor  # noqa: F401
 from apex_tpu import pyprof  # noqa: F401
 from apex_tpu import checkpoint  # noqa: F401
 

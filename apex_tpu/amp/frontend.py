@@ -20,6 +20,7 @@ validation) but applies it functionally:
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Sequence
 
 import flax.linen as nn
@@ -32,6 +33,7 @@ from apex_tpu.amp import scaler as _scaler_mod
 from apex_tpu.amp._amp_state import _amp_state, maybe_print, warn_or_err
 from apex_tpu.amp.properties import Properties, opt_levels
 from apex_tpu.amp.scaler import LossScaler, ScalerState
+from apex_tpu.monitor import hooks as _mon
 from apex_tpu.utils.tree import cast_floating
 
 
@@ -342,6 +344,16 @@ def make_train_step(
     overflow detect, conditional skip of the optimizer step on overflow
     (apex patches ``optimizer.step`` to a no-op; here it is a ``jnp.where``
     on the update), and dynamic scale update — all inside one XLA program.
+
+    The monitoring guard rides along as a static jit argument (a bool:
+    is a traced-hooks recorder attached?): attaching or detaching a
+    ``apex_tpu.monitor`` recorder switches between exactly two cached
+    programs — instrumented and uninstrumented — so each flip costs at
+    most one trace and repeated attach/detach cycles never grow the
+    cache. Device telemetry routes to whichever recorder is attached
+    when a step *runs*; trace-time accounting (collective counts) lands
+    in the recorder attached when the instrumented variant was first
+    traced.
     """
     scaler = scaler or (optimizer._amp_stash.loss_scalers[0]
                         if hasattr(optimizer, "_amp_stash") else LossScaler(1.0))
@@ -353,7 +365,8 @@ def make_train_step(
 
     grad_fn = jax.grad(scaled_loss_fn, has_aux=True)
 
-    def step(params, opt_state, scaler_state: ScalerState, *batch):
+    def step(_mon_on, params, opt_state, scaler_state: ScalerState,
+             *batch):
         grads, (loss, aux) = grad_fn(params, scaler_state, *batch)
         grads, found_inf = _scaler_mod.unscale(grads, scaler_state, out_dtype=grad_dtype)
         new_params, new_opt_state = optimizer.apply(
@@ -363,4 +376,13 @@ def make_train_step(
         outs = (new_params, new_opt_state, new_scaler_state, loss)
         return outs + ((aux,) if has_aux else ())
 
-    return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
+    jitted = jax.jit(step, static_argnums=(0,),
+                     donate_argnums=(1, 2, 3) if donate else ())
+
+    @functools.wraps(step)
+    def run(params, opt_state, scaler_state: ScalerState, *batch):
+        return jitted(_mon.traced_enabled(), params, opt_state,
+                      scaler_state, *batch)
+
+    run._jitted = jitted   # escape hatch: .lower()/.trace() on the inner fn
+    return run

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from apex_tpu.amp import scaler as _scaler_mod
 from apex_tpu.amp._amp_state import _amp_state, maybe_print
+from apex_tpu.monitor import hooks as _mon
 
 
 @contextlib.contextmanager
@@ -61,9 +62,16 @@ def scale_loss(loss, optimizers, loss_id: int = 0, model=None,
     # documented flow), the scaler state now reflects this iteration;
     # surface the skip message like handle.py:138-140.
     if bool(loss_scaler.state.overflow):
+        _mon.counter("amp/scale_loss_overflows", loss_id=loss_id)
         maybe_print(
             f"Gradient overflow.  Skipping step, loss scaler {loss_id} reducing "
             f"loss scale to {float(loss_scaler.state.loss_scale)}")
+    if _mon.enabled():
+        # loss_id 0 (the common case) shares the traced path's gauge
+        # name; extra loss scalers get a namespaced column
+        name = "amp/loss_scale" if loss_id == 0 \
+            else f"amp/loss_scale/{loss_id}"
+        _mon.gauge(name, float(loss_scaler.state.loss_scale))
 
 
 @contextlib.contextmanager

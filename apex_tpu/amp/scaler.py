@@ -20,6 +20,7 @@ from typing import Any, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.monitor import hooks as _mon
 from apex_tpu.utils.tree import tree_all_finite
 
 
@@ -111,16 +112,22 @@ def update(
     (clamped to ``max_loss_scale``). Static scaling is the identity.
     """
     if not dynamic:
-        return ScalerState(state.loss_scale, state.unskipped, found_inf)
-
-    min_scale = jnp.asarray(min_loss_scale if min_loss_scale is not None else 0.0, jnp.float32)
-    shrunk = jnp.maximum(state.loss_scale / scale_factor, jnp.maximum(min_scale, 1.0e-8))
-    unskipped = jnp.where(found_inf, 0, state.unskipped + 1)
-    grow = unskipped >= scale_window
-    grown = jnp.minimum(state.loss_scale * scale_factor, max_loss_scale)
-    new_scale = jnp.where(found_inf, shrunk, jnp.where(grow, grown, state.loss_scale))
-    unskipped = jnp.where(grow, 0, unskipped)
-    return ScalerState(new_scale, unskipped.astype(jnp.int32), found_inf)
+        new_state = ScalerState(state.loss_scale, state.unskipped, found_inf)
+    else:
+        min_scale = jnp.asarray(min_loss_scale if min_loss_scale is not None else 0.0, jnp.float32)
+        shrunk = jnp.maximum(state.loss_scale / scale_factor, jnp.maximum(min_scale, 1.0e-8))
+        unskipped = jnp.where(found_inf, 0, state.unskipped + 1)
+        grow = unskipped >= scale_window
+        grown = jnp.minimum(state.loss_scale * scale_factor, max_loss_scale)
+        new_scale = jnp.where(found_inf, shrunk, jnp.where(grow, grown, state.loss_scale))
+        unskipped = jnp.where(grow, 0, unskipped)
+        new_state = ScalerState(new_scale, unskipped.astype(jnp.int32), found_inf)
+    # telemetry: loss-scale value + overflow flag per executed update
+    # (no-op unless a monitor.Recorder is attached — no inserted ops,
+    # identical jaxpr in the disabled path)
+    _mon.traced_scalar("amp/loss_scale", new_state.loss_scale)
+    _mon.traced_scalar("amp/overflow", found_inf)
+    return new_state
 
 
 class LossScaler:
@@ -152,6 +159,8 @@ class LossScaler:
         self._scale_window = scale_window
         self._min_loss_scale = min_loss_scale
         self._max_loss_scale = max_loss_scale
+        self._skipped_steps = 0     # host-visible total (eager path only)
+        self._growth_resets = 0     # scale_window expiries seen eagerly
         init = init_scale if self.dynamic else float(loss_scale)
         self.state = init_state(init)
 
@@ -177,6 +186,31 @@ class LossScaler:
     def loss_scale(self) -> float:
         return float(self.state.loss_scale)
 
+    def state_summary(self) -> dict:
+        """Public snapshot of the scaler's knobs and counters — use this
+        instead of reaching for private attrs. (Named ``state_summary``
+        because ``state`` is the device-resident :class:`ScalerState`
+        attribute, part of the stable API.)
+
+        ``skipped_steps``/``growth_interval_resets`` count what the
+        *eager* ``update_scale`` path observed; a fully-jitted loop that
+        calls :func:`update` directly keeps its counters on device (read
+        ``unskipped``/``overflow`` from its ScalerState, or attach a
+        ``apex_tpu.monitor`` recorder for per-step telemetry).
+        """
+        return {
+            "scale": float(self.state.loss_scale),
+            "growth_counter": int(self.state.unskipped),
+            "overflow": bool(self.state.overflow),
+            "skipped_steps": self._skipped_steps,
+            "growth_interval_resets": self._growth_resets,
+            "dynamic": self.dynamic,
+            "scale_factor": self._scale_factor,
+            "scale_window": self._scale_window,
+            "min_loss_scale": self._min_loss_scale,
+            "max_loss_scale": self._max_loss_scale,
+        }
+
     def update_scale(self, found_inf=None) -> bool:
         """Eager update; returns True if the step should be skipped.
 
@@ -186,7 +220,17 @@ class LossScaler:
         if found_inf is None:
             found_inf = self.state.overflow
         self.state = self.update_state(self.state, jnp.asarray(found_inf))
-        return bool(self.state.overflow)
+        skipped = bool(self.state.overflow)
+        if skipped:
+            self._skipped_steps += 1
+            _mon.counter("amp/skipped_steps")
+        elif self.dynamic and int(self.state.unskipped) == 0:
+            # on a clean dynamic step the counter is where(grow, 0,
+            # prev+1) with prev+1 >= 1, so 0 iff a growth-interval
+            # expiry just reset it — no pre-update read needed
+            self._growth_resets += 1
+            _mon.counter("amp/growth_interval_resets")
+        return skipped
 
     def clear_overflow_state(self):
         self.state = ScalerState(self.state.loss_scale, self.state.unskipped, jnp.asarray(False))
@@ -197,10 +241,14 @@ class LossScaler:
             "loss_scale": float(self.state.loss_scale),
             "unskipped": int(self.state.unskipped),
             "dynamic": self.dynamic,
+            "skipped_steps": self._skipped_steps,
+            "growth_interval_resets": self._growth_resets,
         }
 
     def load_state_dict(self, sd: dict):
         self.dynamic = sd.get("dynamic", self.dynamic)
+        self._skipped_steps = int(sd.get("skipped_steps", 0))
+        self._growth_resets = int(sd.get("growth_interval_resets", 0))
         self.state = ScalerState(
             jnp.asarray(sd["loss_scale"], jnp.float32),
             jnp.asarray(sd.get("unskipped", 0), jnp.int32),

@@ -8,11 +8,13 @@ from __future__ import annotations
 
 import ctypes
 import threading
+import time
 from typing import Iterator, Optional, Sequence
 
 import numpy as np
 
 from apex_tpu import _native
+from apex_tpu.monitor import hooks as _mon
 
 _BF16_VIEW = np.uint16
 
@@ -286,8 +288,15 @@ class DataLoader:
                         (self.seed + self._epoch * 131071 + submitted) & (2**64 - 1))
                     submitted += 1
                 buf = np.empty(slot_bytes, np.uint8)
+                # host-input wait: how long the consumer blocked on the
+                # worker pool (0 when prefetch kept up with the step)
+                t_wait = time.perf_counter()
                 got = lib.atp_loader_next(
                     handle, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+                if _mon.enabled():
+                    _mon.timer_event("data/host_wait",
+                                     time.perf_counter() - t_wait)
+                    _mon.counter("data/batches")
                 if got < 0:
                     raise RuntimeError("native loader shut down")
                 real = padded[next_out][1]
@@ -319,9 +328,14 @@ class DataLoader:
         t.start()
         try:
             while True:
+                t_wait = time.perf_counter()
                 item = q.get()
+                if _mon.enabled():
+                    _mon.timer_event("data/host_wait",
+                                     time.perf_counter() - t_wait)
                 if item is None:
                     return
+                _mon.counter("data/batches")
                 yield item
         finally:
             stop.set()

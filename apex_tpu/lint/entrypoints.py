@@ -116,6 +116,25 @@ def _pipeline_schedule():
     return fn, (x, w), mesh.axis_names
 
 
+def _amp_train_step_monitored():
+    """The amp train step with a monitor recorder attached: the
+    instrumented variant of ``_amp_train_step``. Attaching happens at
+    trace time (inside the returned fn), so the traced program carries
+    the debug-callback telemetry — this is the gate that keeps the
+    instrumentation itself APX001/APX005-clean and its collectives on
+    canonical axes."""
+    from apex_tpu import monitor
+
+    step, args, allowed = _amp_train_step()
+    rec = monitor.Recorder(name="lint-entrypoint")
+
+    def monitored(*a):
+        with monitor.attached(rec):
+            return step(*a)
+
+    return monitored, args, allowed
+
+
 def _fused_lm_head_ce():
     """Vocab-parallel fused LM-head CE: the pmax/psum trio over the
     tensor axis, plus the Pallas kernels in interpret mode."""
@@ -142,6 +161,7 @@ def _fused_lm_head_ce():
 
 
 register_entrypoint("amp_train_step", _amp_train_step)
+register_entrypoint("amp_train_step_monitored", _amp_train_step_monitored)
 register_entrypoint("tensor_parallel_layers", _tensor_parallel_layers)
 register_entrypoint("pipeline_schedule", _pipeline_schedule)
 register_entrypoint("fused_lm_head_ce", _fused_lm_head_ce)

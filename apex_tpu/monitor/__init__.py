@@ -1,0 +1,89 @@
+"""apex_tpu.monitor — structured training telemetry for TPU training.
+
+The observability subsystem the reference never had on TPU: a typed-event
+:class:`Recorder` (counters, gauges, timers, per-step records in a ring
+buffer, JSONL/JSON output), instrumentation hooks threaded through amp,
+optimizers, the collective mappings, the pipeline schedules and the data
+loader, a trace layer subsuming ``apex_tpu.pyprof`` (XProf annotations,
+compile-event and jit-cache logging, device-memory snapshots), and a CLI
+report (``python -m apex_tpu.monitor report run.jsonl``).
+
+Quick start::
+
+    from apex_tpu import monitor
+
+    rec = monitor.Recorder()
+    monitor.trace.install_compile_logging()      # optional: compile events
+    with monitor.attached(rec):                  # enables package hooks
+        for batch in loader:
+            with rec.step():
+                state = train_step(state, batch)
+    rec.dump_jsonl("run.jsonl")                  # → monitor report CLI
+    print(monitor.render_report(rec.records()))
+
+Guarantees (details: docs/observability.md):
+
+- **disabled = free**: with no recorder attached every hook is one
+  global read + compare; traced programs are byte-identical to the
+  uninstrumented ones (no inserted ops, no retrace).
+- **attach = one retrace**: hot paths that thread the monitoring
+  guard (``amp.make_train_step``, the stateful optimizer ``step``)
+  switch between two cached programs — instrumented/uninstrumented —
+  so a flip costs at most one trace and cycles never grow the cache.
+- **zero deps**: importing this package (and recording host events)
+  touches no jax; jax is imported lazily by the traced hooks and the
+  trace layer (APX001-clean).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from apex_tpu.monitor import _state
+from apex_tpu.monitor import hooks  # noqa: F401
+from apex_tpu.monitor import trace  # noqa: F401
+from apex_tpu.monitor import xprof  # noqa: F401
+from apex_tpu.monitor.recorder import Recorder  # noqa: F401
+from apex_tpu.monitor.report import (  # noqa: F401
+    aggregate, load_jsonl, render_report, render_steps, selfcheck)
+from apex_tpu.monitor.hooks import enabled, epoch  # noqa: F401
+
+
+def get_recorder() -> Recorder | None:
+    """The attached recorder, or None when monitoring is disabled."""
+    return _state.recorder
+
+
+def attach(recorder: Recorder) -> Recorder:
+    """Enable monitoring: route all package hooks to ``recorder``.
+
+    Guard-threaded jitted steps pick up the instrumentation on their
+    next call (at most one trace per guard flip); attach before first
+    use of other jitted code if you want its trace-time events
+    (collective accounting) captured. Device callbacks route to
+    whichever recorder is attached when a program runs.
+    """
+    _state.recorder = recorder
+    _state.epoch += 1
+    return recorder
+
+
+def detach() -> Recorder | None:
+    """Disable monitoring; returns the previously attached recorder."""
+    rec, _state.recorder = _state.recorder, None
+    _state.epoch += 1
+    return rec
+
+
+@contextlib.contextmanager
+def attached(recorder: Recorder):
+    """``with monitor.attached(rec): ...`` — attach for the block."""
+    prev = _state.recorder
+    attach(recorder)
+    try:
+        yield recorder
+    finally:
+        if prev is None:
+            detach()
+        else:
+            attach(prev)

@@ -1,0 +1,57 @@
+"""CLI: render recorder dumps and smoke-test the telemetry pipeline.
+
+    python -m apex_tpu.monitor report run.jsonl [--json] [--max-rows N]
+    python -m apex_tpu.monitor selfcheck [--steps N]
+
+``report`` renders the per-step and aggregate tables from a
+``Recorder.dump_jsonl`` file (the ``pyprof.prof`` analog — per-step
+training telemetry instead of per-kernel nvprof records). ``selfcheck``
+records a synthetic 3-step amp run on CPU and asserts the dump → report
+round trip (used by ``scripts/ci.sh``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m apex_tpu.monitor")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pr = sub.add_parser("report", help="render a recorder JSONL dump")
+    pr.add_argument("path", help="JSONL file from Recorder.dump_jsonl")
+    pr.add_argument("--json", action="store_true",
+                    help="print the aggregate as JSON instead of tables")
+    pr.add_argument("--max-rows", type=int, default=50,
+                    help="per-step table row cap")
+
+    ps = sub.add_parser("selfcheck",
+                        help="record a synthetic run; assert round-trip")
+    ps.add_argument("--steps", type=int, default=3)
+    ps.add_argument("--quiet", action="store_true")
+
+    args = p.parse_args(argv)
+    from apex_tpu.monitor import report as report_mod
+
+    if args.cmd == "report":
+        header, events = report_mod.load_jsonl(args.path)
+        if args.json:
+            print(json.dumps(report_mod.aggregate(events, header=header),
+                             indent=2))
+        else:
+            print(report_mod.render_report(events, header=header,
+                                           max_rows=args.max_rows))
+        return 0
+
+    # selfcheck needs a backend; default to CPU unless the caller chose
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    report_mod.selfcheck(n_steps=args.steps, verbose=not args.quiet)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

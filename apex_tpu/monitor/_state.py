@@ -1,0 +1,28 @@
+"""Module-level monitoring guard.
+
+One attribute read decides whether any hook does work: ``recorder`` is
+the attached :class:`~apex_tpu.monitor.recorder.Recorder` or ``None``.
+Every instrumentation hook in the package begins with::
+
+    rec = _state.recorder
+    if rec is None:
+        return
+
+so disabled-mode cost is one global load + one compare, no jax import,
+no allocation — a jitted step traced while detached is byte-identical
+to the uninstrumented program.
+
+``epoch`` increments on every attach/detach. Jitted wrappers that want
+to pick up a newly-attached recorder (``amp.make_train_step``, the
+stateful optimizer ``step``) thread it through as a static argument:
+flipping the guard changes the static key, forcing exactly one retrace;
+while the guard is stable the cached executable is reused.
+
+This module imports nothing — it exists so ``hooks``/``recorder``/
+``__init__`` can share the guard without an import cycle.
+"""
+
+from __future__ import annotations
+
+recorder = None   # the attached Recorder, or None (monitoring disabled)
+epoch = 0         # bumped on attach/detach; static jit key for hot paths

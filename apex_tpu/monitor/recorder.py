@@ -1,0 +1,269 @@
+"""Typed-event ring-buffer recorder.
+
+The Recorder is the single sink for everything the instrumentation
+hooks emit: host counters and gauges, timers, trace-time collective
+accounting, device scalars arriving through ``jax.debug.callback``, and
+per-step records assembled by the ``step()`` context manager. It is
+deliberately zero-dependency — pure stdlib, no jax import — so it can
+run in data-loader worker threads and in processes that never touch an
+accelerator.
+
+Event model (one dict per event, JSONL-serializable):
+
+- ``counter``   {name, value=increment, total}   monotonic accumulators
+- ``gauge``     {name, value}                    last-value-wins samples
+- ``timer``     {name, value=seconds}            measured durations
+- ``collective``{name="op@axis", value=count, bytes} trace-time accounting
+- ``step``      {step, value=step_time_s, gauges, counters, collectives,
+                 timers}                          one per training step
+
+Events live in a bounded ring (``capacity`` newest kept; ``dropped``
+counts evictions), so a recorder attached for a million steps holds
+memory constant. Aggregation (:meth:`aggregate`) and the CLI report
+(``python -m apex_tpu.monitor report``) consume the JSONL dump.
+
+Threading: hooks may fire from loader worker threads and from runtime
+callback threads; all mutation happens under one lock.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import sys
+import threading
+import time
+from typing import Any, Iterable, Optional
+
+
+def _effects_barrier():
+    """Drain pending jax debug callbacks so device scalars land in the
+    step record that produced them. Guarded on jax being imported —
+    never the importer of it."""
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            jax.effects_barrier()
+        except Exception:
+            pass
+
+
+class Recorder:
+    """Collects typed telemetry events into a bounded ring buffer.
+
+    Typical lifecycle::
+
+        rec = monitor.Recorder()
+        with monitor.attached(rec):          # enables the package hooks
+            for batch in loader:
+                with rec.step():             # one per-step record
+                    out = train_step(...)
+        rec.dump_jsonl("run.jsonl")
+        print(monitor.render_report(rec.records()))
+
+    All emit methods are also callable directly (without any hook
+    involvement) for user-level metrics.
+    """
+
+    def __init__(self, capacity: int = 65536, name: str = "run",
+                 meta: Optional[dict] = None, traced_hooks: bool = True):
+        self.name = name
+        self.capacity = int(capacity)
+        self.meta = dict(meta or {})
+        # traced_hooks=False makes this a host-only observer: the traced
+        # hook family (traced_scalar/traced_tick/collective/schedule and
+        # the optimizer norm gauges) stays dormant, so compiled programs
+        # are untouched while host timers and compile events still land.
+        # bench.py uses this to time UNperturbed programs.
+        self.traced_hooks = bool(traced_hooks)
+        self._events: collections.deque = collections.deque(
+            maxlen=self.capacity)
+        self._emitted = 0              # lifetime count (ring may evict)
+        self._counters: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+        self._collectives: dict[str, dict] = {}   # "op@axis" -> {count, bytes}
+        self._lock = threading.RLock()
+        self._step_idx = 0
+        self._open_step: Optional[dict] = None
+        self._t0 = time.perf_counter()
+
+    # -- internals ---------------------------------------------------------
+    def _emit(self, kind: str, name: str, value, **extra) -> dict:
+        ev = {"kind": kind, "name": name, "value": value,
+              "t": round(time.perf_counter() - self._t0, 6)}
+        if extra:
+            ev.update(extra)
+        with self._lock:
+            if self._open_step is not None:
+                ev["step"] = self._open_step["step"]
+            self._events.append(ev)
+            self._emitted += 1
+        return ev
+
+    @property
+    def dropped(self) -> int:
+        """Events evicted from the ring so far."""
+        with self._lock:
+            return self._emitted - len(self._events)
+
+    # -- host-side primitives ----------------------------------------------
+    def counter(self, name: str, inc: float = 1, **extra) -> float:
+        with self._lock:
+            total = self._counters.get(name, 0) + inc
+            self._counters[name] = total
+            step = self._open_step
+            if step is not None:
+                step["counters"][name] = step["counters"].get(name, 0) + inc
+        self._emit("counter", name, inc, total=total, **extra)
+        return total
+
+    def gauge(self, name: str, value, **extra):
+        value = float(value)
+        with self._lock:
+            self._gauges[name] = value
+            step = self._open_step
+            if step is not None:
+                step["gauges"][name] = value
+        self._emit("gauge", name, value, **extra)
+
+    def timer_event(self, name: str, seconds: float, **extra):
+        with self._lock:
+            step = self._open_step
+            if step is not None:
+                t = step["timers"].setdefault(name, {"n": 0, "total_s": 0.0})
+                t["n"] += 1
+                t["total_s"] = round(t["total_s"] + seconds, 6)
+        with self._lock:
+            self._counters[name + "/total_s"] = round(
+                self._counters.get(name + "/total_s", 0.0) + seconds, 6)
+        self._emit("timer", name, round(seconds, 6), **extra)
+
+    @contextlib.contextmanager
+    def timer(self, name: str, **extra):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.timer_event(name, time.perf_counter() - t0, **extra)
+
+    def collective(self, op: str, axis_name: str, nbytes: int = 0,
+                   count: int = 1):
+        """Trace-time collective accounting: called by the mapping/DDP
+        hooks while a program is being traced, so totals are per traced
+        program, not per executed step (XLA runs the same collectives
+        every step; re-tracing re-counts)."""
+        key = f"{op}@{axis_name}"
+        with self._lock:
+            slot = self._collectives.setdefault(
+                key, {"count": 0, "bytes": 0})
+            slot["count"] += int(count)
+            slot["bytes"] += int(nbytes)
+        self._emit("collective", key, int(count), bytes=int(nbytes))
+
+    # -- device-side arrivals (jax.debug.callback target) -------------------
+    def _device_scalar(self, name: str, value):
+        """Target of the traced-scalar hooks; runs on the host when the
+        device value is materialized. Behaves like a gauge."""
+        try:
+            self.gauge(name, float(value))
+        except (TypeError, ValueError):
+            pass
+
+    def _device_tick(self, name: str, tick):
+        """Target of per-tick schedule marks: records host-arrival time
+        of pipeline tick ``tick`` (an ordering/progress signal; device
+        step attribution belongs to XProf)."""
+        try:
+            self._emit("tick", name, int(tick))
+        except (TypeError, ValueError):
+            pass
+
+    # -- per-step records ---------------------------------------------------
+    @contextlib.contextmanager
+    def step(self, **meta):
+        """Open a per-step record; on exit, drains pending device
+        callbacks and appends a ``step`` event carrying the step wall
+        time plus every gauge/counter/timer observed during the step and
+        the cumulative collective table."""
+        with self._lock:
+            idx = self._step_idx
+            self._step_idx += 1
+            self._open_step = {"step": idx, "gauges": {}, "counters": {},
+                               "timers": {}}
+        t0 = time.perf_counter()
+        try:
+            yield idx
+        finally:
+            _effects_barrier()
+            dur = time.perf_counter() - t0
+            with self._lock:
+                rec = self._open_step
+                self._open_step = None
+                collectives = {k: dict(v)
+                               for k, v in self._collectives.items()}
+            ev = {"kind": "step", "name": "step", "step": rec["step"],
+                  "value": round(dur, 6), "step_time_s": round(dur, 6),
+                  "t": round(t0 - self._t0, 6),
+                  "gauges": rec["gauges"], "counters": rec["counters"],
+                  "timers": rec["timers"], "collectives": collectives}
+            if meta:
+                ev["meta"] = {k: v for k, v in meta.items()}
+            with self._lock:
+                self._events.append(ev)
+                self._emitted += 1
+
+    # -- views ---------------------------------------------------------------
+    def records(self, kind: Optional[str] = None) -> list[dict]:
+        with self._lock:
+            evs = list(self._events)
+        if kind is None:
+            return evs
+        return [e for e in evs if e["kind"] == kind]
+
+    def steps(self) -> list[dict]:
+        return self.records("step")
+
+    def counters(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._counters)
+
+    def gauges(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._gauges)
+
+    def collectives(self) -> dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._collectives.items()}
+
+    # -- output --------------------------------------------------------------
+    def dump_jsonl(self, path_or_file) -> int:
+        """Write one JSON object per event (newest ``capacity`` events);
+        first line is a header record. Returns the number of event lines
+        written."""
+        _effects_barrier()
+        header = {"kind": "header", "name": self.name,
+                  "capacity": self.capacity, "dropped": self.dropped,
+                  "meta": self.meta}
+        evs = self.records()
+        if hasattr(path_or_file, "write"):
+            f = path_or_file
+            close = False
+        else:
+            f = open(path_or_file, "w")
+            close = True
+        try:
+            f.write(json.dumps(header) + "\n")
+            for e in evs:
+                f.write(json.dumps(e) + "\n")
+        finally:
+            if close:
+                f.close()
+        return len(evs)
+
+    def aggregate(self) -> dict:
+        """Aggregated summary (the JSON the CLI report renders)."""
+        from apex_tpu.monitor.report import aggregate
+        _effects_barrier()
+        return aggregate(self.records(), header={
+            "name": self.name, "dropped": self.dropped, "meta": self.meta})

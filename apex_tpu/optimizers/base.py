@@ -39,6 +39,7 @@ from typing import Any, Callable, NamedTuple, Sequence
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.monitor import hooks as _mon
 from apex_tpu.utils.tree import tree_all_finite
 
 
@@ -182,6 +183,11 @@ class FusedOptimizerBase:
         plist = [params] if single else list(params)
         glist = [grads] if single else list(grads)
 
+        # telemetry accumulators (only populated with a traced-hooks
+        # recorder attached — the disabled path inserts no ops)
+        monitoring = _mon.traced_enabled()
+        gn_sq = un_sq = None
+
         new_params, new_groups = [], []
         for group, gstate, p, g in zip(self.param_groups, state.groups, plist, glist):
             group = {**group, **{k: v for k, v in overrides.items() if v is not None}}
@@ -208,6 +214,15 @@ class FusedOptimizerBase:
 
                 new_p32, new_slots = jax.lax.cond(skip, _skip, _do)
                 new_step = jnp.where(skip, gstate.step, step)
+            if monitoring:
+                def _sq(tree):
+                    return sum(
+                        (jnp.sum(jnp.square(x.astype(jnp.float32)))
+                         for x in jax.tree.leaves(tree)),
+                        jnp.zeros((), jnp.float32))
+                gn_sq = _sq(g32) + (gn_sq if gn_sq is not None else 0.0)
+                dp = jax.tree.map(lambda a, b: a - b, new_p32, p32)
+                un_sq = _sq(dp) + (un_sq if un_sq is not None else 0.0)
             master = new_p32 if gstate.master is not None else None
             new_groups.append(GroupState(new_step.astype(jnp.int32), master, new_slots))
 
@@ -217,6 +232,11 @@ class FusedOptimizerBase:
             new_params.append(jax.tree.map(
                 lambda x, ref: _cast_fresh(x, ref.dtype), new_p32, p))
 
+        if monitoring and gn_sq is not None:
+            # whole-step l2 norms of the (unscaled, fp32) grads and of
+            # the applied parameter delta (0 when the step was skipped)
+            _mon.traced_scalar("optim/grad_norm", jnp.sqrt(gn_sq))
+            _mon.traced_scalar("optim/update_norm", jnp.sqrt(un_sq))
         out_params = new_params[0] if single else new_params
         return out_params, OptimizerState(groups=tuple(new_groups))
 
@@ -325,16 +345,22 @@ class FusedOptimizerBase:
         if self._scaler is not None:
             from apex_tpu.amp import scaler as scaler_mod
 
-            def _full(params, state, sstate, grads):
+            def _full(_mon_on, params, state, sstate, grads):
+                # _mon_on is only the static cache key: the monitoring
+                # guard is read (at trace time) inside apply/update, and
+                # keying the jit on the bool keeps BOTH variants cached —
+                # attach/detach cycles alternate between two compiled
+                # programs instead of retracing each flip
                 g, found_inf = scaler_mod.unscale(grads, sstate)
                 p, st = self.apply(state, params, g, skip=found_inf)
                 ss = self._scaler.update_state(sstate, found_inf)
                 return p, st, ss
 
             if self._jit_step is None:
-                self._jit_step = jax.jit(_full)
+                self._jit_step = jax.jit(_full, static_argnums=(0,))
             self.params, self.state, self._scaler.state = self._jit_step(
-                self.params, self.state, self._scaler.state, grads)
+                _mon.traced_enabled(), self.params, self.state,
+                self._scaler.state, grads)
         else:
             # no scaler: raw optimizer semantics, no overflow guard
             # (matches torch/apex where the bare optimizer never checks)

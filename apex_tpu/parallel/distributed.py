@@ -29,6 +29,7 @@ from typing import Any, Callable, Sequence
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.monitor import hooks as _mon
 from apex_tpu.utils.flat import flatten_tensors, unflatten_tensors
 from apex_tpu.utils.parity import warn_inert_once as _warn_inert_once
 from apex_tpu._compat import axis_size as _axis_size
@@ -46,6 +47,19 @@ def allreduce_gradients(
     (``apex/parallel/distributed.py:425-468`` allreduce_bucket +
     allreduce_maybe_retain)."""
     world = _axis_size(axis_name)
+    if _mon.traced_enabled():
+        # trace-time accounting: one psum per floating leaf (XLA may
+        # fuse them, but the wire volume is the same), sized at the
+        # dtype actually reduced — allreduce_always_fp32 upcasts bf16/
+        # fp16 leaves before the collective, doubling their bytes
+        floats = [g for g in jax.tree.leaves(grads)
+                  if jnp.issubdtype(g.dtype, jnp.floating)]
+        if allreduce_always_fp32:
+            nbytes = sum(g.size * 4 for g in floats)
+        else:
+            nbytes = _mon.tree_bytes(floats)
+        _mon.collective("psum", axis_name, nbytes=nbytes,
+                        count=len(floats))
 
     def _one(g):
         if not jnp.issubdtype(g.dtype, jnp.floating):

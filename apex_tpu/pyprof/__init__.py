@@ -1,18 +1,23 @@
-"""apex_tpu.pyprof — profiling/annotation layer on jax.profiler + XLA.
+"""apex_tpu.pyprof — parity shim over :mod:`apex_tpu.monitor`.
 
-Reference: ``apex/pyprof`` (deprecated in apex) — three parts:
-``nvtx`` (annotate every op with name/args/callstack,
-``apex/pyprof/nvtx/nvmarker.py:67-108,206``), ``parse`` (read the nvprof
-SQLite DB), ``prof`` (map kernels to op semantics, compute FLOPs/bytes,
-``apex/pyprof/prof/*.py``).
+Reference: ``apex/pyprof`` (deprecated in apex) — ``nvtx`` (annotate
+ops), ``parse`` (read the nvprof SQLite DB), ``prof`` (map kernels to
+op semantics with FLOPs/bytes). The implementations now live in the
+monitor subsystem, which extends them with recorder-integrated
+telemetry (docs/observability.md); this package re-exports the historic
+names so ported code and the parity API keep working:
 
-TPU mapping: annotation = ``jax.profiler`` trace annotations (visible in
-TensorBoard/XProf, replacing NVTX); parse/prof = XLA's own cost analysis
-on the compiled executable (FLOPs/bytes per program without re-deriving
-them from kernel names).
+- ``pyprof.annotate/init/wrap``      → ``monitor.trace``
+- ``pyprof.trace/cost_analysis/flop_report`` → ``monitor.trace``
+- ``pyprof.parse`` (op_stats, top_ops, format_table) → ``monitor.xprof``
+
+The per-step training report the reference's ``pyprof.prof`` CLI
+approximated per-kernel is now ``python -m apex_tpu.monitor report``.
 """
 
-from apex_tpu.pyprof.nvtx import annotate, init, wrap  # noqa: F401
-from apex_tpu.pyprof.prof import cost_analysis, flop_report, trace  # noqa: F401
+from apex_tpu.monitor.trace import annotate, init, wrap  # noqa: F401
+from apex_tpu.monitor.trace import cost_analysis, flop_report, trace  # noqa: F401
+from apex_tpu.pyprof import nvtx  # noqa: F401
 from apex_tpu.pyprof import parse  # noqa: F401
-from apex_tpu.pyprof.parse import format_table, op_stats, top_ops  # noqa: F401
+from apex_tpu.pyprof import prof  # noqa: F401
+from apex_tpu.monitor.xprof import format_table, op_stats, top_ops  # noqa: F401

@@ -1,56 +1,8 @@
-"""Trace annotations (the NVTX analog).
+"""Trace annotations (the NVTX analog) — re-export shim.
 
-Reference: ``apex/pyprof/nvtx/nvmarker.py`` monkey-patches every torch
-function to push an NVTX range encoding op + args + call stack. JAX
-equivalence: ``jax.named_scope`` tags the HLO (visible in XProf per-op),
-``jax.profiler.TraceAnnotation`` tags host timeline ranges; ``wrap``
-decorates any callable with both, including arg shapes like the
-reference's marker payload.
+The implementation moved to :mod:`apex_tpu.monitor.trace` (the monitor
+subsystem's trace layer subsumes pyprof); ``init``/``annotate``/``wrap``
+keep the reference parity API (``apex/pyprof/nvtx/nvmarker.py``).
 """
 
-from __future__ import annotations
-
-import contextlib
-import functools
-import json
-
-import jax
-
-
-def init(enable: bool = True):
-    """Parity shim for ``pyprof.nvtx.init()``: JAX needs no global
-    patching — annotation is opt-in via :func:`annotate`/:func:`wrap`."""
-    return enable
-
-
-@contextlib.contextmanager
-def annotate(name: str, **metadata):
-    """Named range visible in the XProf host timeline and HLO op names."""
-    payload = name if not metadata else f"{name}|{json.dumps(metadata, default=str)}"
-    with jax.profiler.TraceAnnotation(payload):
-        with jax.named_scope(name):
-            yield
-
-
-def _describe_args(args, kwargs):
-    def one(x):
-        if hasattr(x, "shape") and hasattr(x, "dtype"):
-            return f"{x.dtype}{list(x.shape)}"
-        return type(x).__name__
-    return {
-        "args": [one(a) for a in args],
-        "kwargs": {k: one(v) for k, v in kwargs.items()},
-    }
-
-
-def wrap(fn, name: str | None = None):
-    """Decorate ``fn`` with an annotation carrying the op name and arg
-    shapes (the ``add_wrapper`` payload, ``nvmarker.py:206``)."""
-    label = name or getattr(fn, "__name__", "fn")
-
-    @functools.wraps(fn)
-    def wrapper(*args, **kwargs):
-        with annotate(label, **_describe_args(args, kwargs)):
-            return fn(*args, **kwargs)
-
-    return wrapper
+from apex_tpu.monitor.trace import annotate, init, wrap  # noqa: F401

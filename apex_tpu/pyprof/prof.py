@@ -1,57 +1,9 @@
-"""FLOPs/bytes accounting from XLA (the ``pyprof.prof`` analog).
+"""FLOPs/bytes accounting — re-export shim over
+:mod:`apex_tpu.monitor.trace` (the implementation's new home).
 
-Reference: ``apex/pyprof/prof/*.py`` reconstructs per-kernel FLOPs and
-bytes from parsed nvprof records with one class per op family. XLA
-already computes this during compilation, so the TPU version just asks
-the compiled executable — exact for the program actually run, including
-fusion (which the reference's name-based reconstruction cannot see).
+``cost_analysis``/``flop_report`` ask the compiled executable for XLA's
+own cost analysis (exact post-fusion, unlike the reference's name-based
+reconstruction); ``trace`` captures an XProf session.
 """
 
-from __future__ import annotations
-
-import contextlib
-from typing import Any, Callable
-
-import jax
-
-
-def cost_analysis(fn: Callable, *args, **kwargs) -> dict:
-    """Compile ``fn`` and return XLA's cost analysis dict
-    (``flops``, ``bytes accessed``, per-memory-space breakdowns)."""
-    lowered = jax.jit(fn).lower(*args, **kwargs)
-    compiled = lowered.compile()
-    ca = compiled.cost_analysis()
-    if isinstance(ca, (list, tuple)):
-        ca = ca[0] if ca else {}
-    return dict(ca) if ca else {}
-
-
-def flop_report(fn: Callable, *args, step_time_s: float | None = None,
-                peak_flops: float | None = None, **kwargs) -> dict:
-    """FLOPs/bytes + arithmetic intensity (+ MFU when timings given) —
-    the summary ``pyprof.prof`` prints per kernel, at whole-program
-    granularity."""
-    ca = cost_analysis(fn, *args, **kwargs)
-    flops = float(ca.get("flops", 0.0))
-    byts = float(ca.get("bytes accessed", 0.0))
-    rep = {
-        "flops": flops,
-        "bytes_accessed": byts,
-        "arithmetic_intensity": flops / byts if byts else float("inf"),
-    }
-    if step_time_s:
-        rep["achieved_flops_per_s"] = flops / step_time_s
-        if peak_flops:
-            rep["mfu"] = flops / step_time_s / peak_flops
-    return rep
-
-
-@contextlib.contextmanager
-def trace(logdir: str, create_perfetto_link: bool = False):
-    """Capture an XProf trace of the block (the nvprof-session analog);
-    view with TensorBoard's profile plugin."""
-    jax.profiler.start_trace(logdir, create_perfetto_link=create_perfetto_link)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+from apex_tpu.monitor.trace import cost_analysis, flop_report, trace  # noqa: F401

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu._compat import axis_size as _axis_size
+from apex_tpu.monitor import hooks as _mon
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.microbatches import resolve_num_microbatches
 from apex_tpu.transformer.pipeline_parallel.p2p import (
@@ -48,12 +49,21 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
     n_stages = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     total_ticks = n_microbatches + n_stages - 1
+    _mon.pipeline_schedule("fill_drain", n_stages, n_microbatches,
+                           total_ticks)
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
     h_shape = x.shape[1:]
     init_held = jnp.zeros(h_shape, x.dtype)
     init_out = jnp.zeros((n_microbatches,) + h_shape, x.dtype)
 
+    # NB: no per-tick marks here — this scan is differentiated through
+    # (fwd/bwd schedules take value_and_grad of it) and partial-eval
+    # silently drops debug callbacks from differentiated regions, which
+    # would make tick telemetry appear in inference and vanish in
+    # training. The 1F1B schedules below build their backward manually
+    # in a non-differentiated scan, so THEY carry the tick marks; this
+    # schedule records its geometry/bubble estimate only.
     def tick(carry, t):
         held, outputs = carry
         inject_idx = jnp.clip(t, 0, n_microbatches - 1)
@@ -286,6 +296,7 @@ def forward_backward_pipelining_1f1b_model(
     is_first = rank == 0
     delay = 2 * (n_stages - 1)
     total_ticks = n_microbatches + delay
+    _mon.pipeline_schedule("1f1b", n_stages, n_microbatches, total_ticks)
     stash_slots = max(1, 2 * n_stages - 1)
 
     slice_mb = _mb_slicer(inputs)
@@ -302,6 +313,7 @@ def forward_backward_pipelining_1f1b_model(
 
     def tick(carry, i):
         held_f, held_b, stash, grads, loss_sum = carry
+        _mon.traced_tick("pipeline/1f1b/tick", i)
 
         # -- forward unit ------------------------------------------------
         m_f = i - rank
@@ -429,6 +441,8 @@ def forward_backward_pipelining_1f1b_interleaved_model(
     # last backward: microbatch nmb-1 at global stage 0
     total_ticks = ((n_microbatches - 1) // P) * D + (n_microbatches - 1) % P \
         + 2 * (D - 1) + 1
+    _mon.pipeline_schedule("interleaved_1f1b", n_stages, n_microbatches,
+                           total_ticks, useful_ticks=V * n_microbatches)
     stash_slots = 2 * P + 1
 
     slice_mb = _mb_slicer(inputs)
@@ -450,6 +464,7 @@ def forward_backward_pipelining_1f1b_interleaved_model(
 
     def tick(carry, i):
         held_f, held_b, stash, grads, loss_sum = carry
+        _mon.traced_tick("pipeline/interleaved_1f1b/tick", i)
 
         # -- forward unit (same enumeration as the fill-drain schedule) --
         u = i - rank
@@ -636,6 +651,8 @@ def pipeline_apply_interleaved(stage_fn: Callable, chunk_params, x,
             f"interleaved schedule needs n_microbatches ({n_microbatches}) "
             f"divisible by pipeline size ({n_stages})")
     total_ticks = V * n_microbatches + n_stages - 1
+    _mon.pipeline_schedule("interleaved", n_stages, n_microbatches,
+                           total_ticks, useful_ticks=V * n_microbatches)
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
     h_shape = x.shape[1:]

@@ -24,6 +24,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.monitor import hooks as _mon
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu._compat import axis_size as _axis_size
 
@@ -40,6 +41,7 @@ def _copy_fwd(x, axis_name):
 
 
 def _copy_bwd(axis_name, _, dy):
+    _mon.collective("psum", axis_name, dy)
     return (jax.lax.psum(dy, axis_name),)
 
 
@@ -50,10 +52,12 @@ copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
 def reduce_from_tensor_model_parallel_region(x, axis_name: str = ps.TENSOR_AXIS):
+    _mon.collective("psum", axis_name, x)
     return jax.lax.psum(x, axis_name)
 
 
 def _reduce_fwd(x, axis_name):
+    _mon.collective("psum", axis_name, x)
     return jax.lax.psum(x, axis_name), None
 
 
@@ -83,6 +87,7 @@ def _scatter_fwd(x, axis_name, dim):
 
 
 def _scatter_bwd(axis_name, dim, _, dy):
+    _mon.collective("all_gather", axis_name, dy)
     return (jax.lax.all_gather(dy, axis_name, axis=dim if dim >= 0 else dy.ndim + dim, tiled=True),)
 
 
@@ -93,6 +98,7 @@ scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
 def gather_from_tensor_model_parallel_region(x, axis_name: str = ps.TENSOR_AXIS, dim: int = -1):
+    _mon.collective("all_gather", axis_name, x)
     return jax.lax.all_gather(x, axis_name, axis=dim if dim >= 0 else x.ndim + dim, tiled=True)
 
 
@@ -125,14 +131,17 @@ def gather_from_sequence_parallel_region(x, axis_name: str = ps.TENSOR_AXIS,
     ``_GatherFromSequenceParallelRegion`` with
     ``tensor_parallel_output_grad=True``). A plain local chunk here
     silently drops (tp-1)/tp of the gradient."""
+    _mon.collective("all_gather", axis_name, x)
     return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)
 
 
 def _sp_gather_fwd(x, axis_name, dim):
+    _mon.collective("all_gather", axis_name, x)
     return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True), None
 
 
 def _sp_gather_bwd(axis_name, dim, _, dy):
+    _mon.collective("psum_scatter", axis_name, dy)
     return (jax.lax.psum_scatter(dy, axis_name, scatter_dimension=dim,
                                  tiled=True),)
 
@@ -145,16 +154,19 @@ def reduce_scatter_to_sequence_parallel_region(
         x, axis_name: str = ps.TENSOR_AXIS, dim: int = 0):
     """fwd reduce-scatter along ``dim``, bwd all-gather — the Megatron-SP
     "g" in the sequence-parallel MLP/attention sandwich."""
+    _mon.collective("psum_scatter", axis_name, x)
     return jax.lax.psum_scatter(x, axis_name, scatter_dimension=dim,
                                 tiled=True)
 
 
 def _rs_fwd(x, axis_name, dim):
+    _mon.collective("psum_scatter", axis_name, x)
     return jax.lax.psum_scatter(x, axis_name, scatter_dimension=dim,
                                 tiled=True), None
 
 
 def _rs_bwd(axis_name, dim, _, dy):
+    _mon.collective("all_gather", axis_name, dy)
     return (jax.lax.all_gather(dy, axis_name, axis=dim, tiled=True),)
 
 

@@ -40,6 +40,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import time
 
@@ -146,15 +147,38 @@ def _median_iqr(xs):
     return med, q3 - q1
 
 
-def _timed_windows(fn, windows=WINDOWS):
+def _timed_windows(fn, windows=WINDOWS, label=None):
     """Run ``fn`` (must block on completion) once to warm, then time
-    ``windows`` calls; returns the list of wall times."""
-    fn()
+    ``windows`` calls; returns the list of wall times.
+
+    All timing is routed through ``apex_tpu.monitor``: ``main()``
+    attaches a host-only recorder (``traced_hooks=False`` — the timed
+    programs stay byte-identical, no inserted callbacks) with compile
+    logging installed, so the warmup call's backend-compile seconds land
+    as the ``<label>/compile_s`` gauge and every window as a
+    ``<label>/window`` timer event. The compile-vs-steady breakdown in
+    the emitted JSON is read back from these (see ``main``)."""
+    from apex_tpu import monitor
+    rec = monitor.get_recorder()
+    tag = label or "bench"
+    c0 = monitor.trace.compile_seconds(rec)
+    with (rec.timer(f"{tag}/warmup") if rec else contextlib.nullcontext()):
+        fn()
+    if rec is not None:
+        dc = monitor.trace.compile_seconds(rec) - c0
+        if dc > 0:
+            rec.gauge(f"{tag}/compile_s", round(dc, 3))
     times = []
     for _ in range(windows):
+        # bare timing first, recorder emit after: the emit's lock/dict
+        # work must not sit inside the measured window (it would bias
+        # the sub-ms dispatch-overhead metric)
         t0 = time.perf_counter()
         fn()
-        times.append(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        times.append(dt)
+        if rec is not None:
+            rec.timer_event(f"{tag}/window", dt)
     return times
 
 
@@ -200,7 +224,8 @@ def _time_steps(opt_level: str, want_flops: bool = False,
 
     multi = _scanned(step1)
     carry = (params, stats, opt_state, sstate)
-    times = _timed_windows(lambda: float(multi(carry)[1]))
+    times = _timed_windows(lambda: float(multi(carry)[1]),
+                           label=f"rn50_{opt_level.lower()}")
     med, iqr = _median_iqr([t / SCAN_K for t in times])
     return BATCH / med, med, flops, iqr, dispatch_dt
 
@@ -386,7 +411,8 @@ def _time_train_step(step1, carry, tokens, flops, profile=None,
     dispatch_dt = (time.perf_counter() - t0) / n
 
     multi = _scanned(step1)
-    times = _timed_windows(lambda: float(multi(carry)[1]))
+    times = _timed_windows(lambda: float(multi(carry)[1]),
+                           label=profile or "train")
     med, iqr = _median_iqr([t / SCAN_K for t in times])
     peak = _peak_flops()
     mfu = flops / med / peak if (flops and peak) else None
@@ -457,14 +483,14 @@ def _gpt_step_setup(b, s, seed, **cfg_kw):
     return model, v, ids, step1
 
 
-def _time_gpt_variant(b, s, seed, k=16, **cfg_kw):
+def _time_gpt_variant(b, s, seed, k=16, label=None, **cfg_kw):
     """Shared K-step timing for the GPT variant benches (long-seq, MoE):
     returns (tokens_per_sec, step_s, iqr_s). K=16 suits the ~140-190 ms
     steps of these shapes (dispatch overhead amortizes to ~7 ms/window).
     """
     _, v, ids, step1 = _gpt_step_setup(b, s, seed=seed, **cfg_kw)
     multi = _scanned(step1, k)
-    times = _timed_windows(lambda: float(multi((v, ids))[1]))
+    times = _timed_windows(lambda: float(multi((v, ids))[1]), label=label)
     med, iqr = _median_iqr([t / k for t in times])
     return b * s / med, med, iqr
 
@@ -474,7 +500,7 @@ def _bench_gpt_long_seq():
     artifact — flash attention past the fused-backward VMEM gate on the
     two-kernel path, fused LM-head CE at 4x the bench token count per
     row."""
-    return _time_gpt_variant(2, 4096, seed=3)
+    return _time_gpt_variant(2, 4096, seed=3, label="gpt_s4096")
 
 
 def _bench_convergence(families=("rn50", "gpt"), only=None):
@@ -801,7 +827,7 @@ def _bench_ring_s32k():
     kk = jnp.asarray(rng.randn(b, h, s, d) * 0.1, jnp.bfloat16)
     v = jnp.asarray(rng.randn(b, h, s, d) * 0.1, jnp.bfloat16)
 
-    def timed_path(attn_fn, *operands):
+    def timed_path(attn_fn, *operands, label=None):
         def body(c, _):
             dq, dk, dv = jax.grad(
                 lambda q, kk, v: jnp.sum(attn_fn(q, kk, v)
@@ -817,14 +843,26 @@ def _bench_ring_s32k():
 
         # compile ONCE; the same executable serves the timed windows and
         # the memory analysis (a separate .lower().compile() would pay a
-        # second multi-minute XLA compile of this s=32k graph)
+        # second multi-minute XLA compile of this s=32k graph). The
+        # compile happens here, outside _timed_windows' warmup, so its
+        # seconds are attributed to the label explicitly — otherwise the
+        # bench's LARGEST compile would be missing from compile_breakdown
+        from apex_tpu import monitor as _monitor
+        _rec = _monitor.get_recorder()
+        _c0 = _monitor.trace.compile_seconds(_rec)
         compiled = jax.jit(multi_fn).lower(operands).compile()
-        times = _timed_windows(lambda: float(compiled(operands)))
+        if _rec is not None and label:
+            _dc = _monitor.trace.compile_seconds(_rec) - _c0
+            if _dc > 0:
+                _rec.gauge(f"{label}/compile_s", round(_dc, 3))
+        times = _timed_windows(lambda: float(compiled(operands)),
+                               label=label)
         med, iqr = _median_iqr([t / k for t in times])
         return med, iqr, compiled
 
     flat_med, flat_iqr, flat_multi = timed_path(
-        lambda q, kk, v: flash_attention(q, kk, v, causal=True), q, kk, v)
+        lambda q, kk, v: flash_attention(q, kk, v, causal=True), q, kk, v,
+        label="ring_s32k_flash")
     # the ring path needs its context axis bound: a 1-device mesh +
     # shard_map makes cp=1 real (the ring collectives become no-op
     # self-permutes, which is exactly the kernel-path overhead to price)
@@ -837,7 +875,8 @@ def _bench_ring_s32k():
         zigzag_ring_self_attention, mesh=mesh,
         in_specs=(P(), P(), P()), out_specs=P(), check_vma=False)
     qz, kz, vz = (zigzag_split(x, 1) for x in (q, kk, v))
-    ring_med, ring_iqr, _ = timed_path(ring_fn, qz, kz, vz)
+    ring_med, ring_iqr, _ = timed_path(ring_fn, qz, kz, vz,
+                                       label="ring_s32k_zigzag")
     ps.destroy_model_parallel()
 
     temp_gb = None
@@ -878,7 +917,8 @@ def _bench_dispatch_overhead():
         return x + 1.0
 
     float(noop(one))
-    times = _timed_windows(lambda: float(noop(one)), windows=9)
+    times = _timed_windows(lambda: float(noop(one)), windows=9,
+                           label="noop")
     med, iqr = _median_iqr(times)
     return {"noop_roundtrip_ms": round(med * 1e3, 2),
             "noop_iqr_ms": round(iqr * 1e3, 2)}
@@ -919,8 +959,10 @@ def _bench_gpt_moe():
 
     b, s = 8, 1024
     moe_kw = dict(moe_num_experts=8, moe_every=2)
-    top2 = _time_gpt_variant(b, s, seed=5, moe_top_k=2, **moe_kw)
-    top1 = _time_gpt_variant(b, s, seed=5, moe_top_k=1, **moe_kw)
+    top2 = _time_gpt_variant(b, s, seed=5, moe_top_k=2,
+                          label="gpt_moe_top2", **moe_kw)
+    top1 = _time_gpt_variant(b, s, seed=5, moe_top_k=1,
+                          label="gpt_moe_top1", **moe_kw)
 
     # useful-FLOPs numerator (docstring): all-XLA DENSE compiled count
     # + analytic extra expert passes
@@ -1019,7 +1061,55 @@ def _bench_bert():
                             profile="bert")
 
 
+def _monitor_extras(rec):
+    """Compile-vs-steady breakdown + run telemetry for the BENCH JSON.
+
+    ``compile_breakdown``: per timed metric, the backend-compile seconds
+    its warmup (or explicit pre-compile, for ring_s32k) paid — from the
+    jax.monitoring listeners — next to the steady-state window stats:
+    the split that makes 'slow bench' vs 'slow step' attributable.
+    Rows need not sum to ``monitor.backend_compile_s_total``: compiles
+    outside any labeled window (FLOP-count lowers, dispatch warmups)
+    count toward the total but belong to no metric. All existing JSON
+    keys are unchanged; these are additive."""
+    gauges = rec.gauges()
+    timers = rec.aggregate().get("timers", {})
+    breakdown = {}
+    for k, v in gauges.items():
+        if not k.endswith("/compile_s"):
+            continue
+        tag = k[:-len("/compile_s")]
+        row = {"compile_s": v}
+        w = timers.get(f"{tag}/window")
+        if w:
+            row["steady_window_s"] = {
+                "n": w["n"], "mean_s": w["mean_s"],
+                "total_s": w["total_s"]}
+        breakdown[tag] = row
+    counters = rec.counters()
+    return {
+        "compile_breakdown": breakdown,
+        "monitor": {
+            "backend_compile_s_total": counters.get(
+                "jax/compile/backend/total_s", 0.0),
+            "jaxpr_trace_s_total": counters.get(
+                "jax/compile/trace/total_s", 0.0),
+            "compile_cache_misses": counters.get(
+                "jax/compile/cache_miss", 0),
+            "events": len(rec.records()),
+        },
+    }
+
+
 def main():
+    from apex_tpu import monitor
+    # host-only observer: times and compile events flow into the
+    # recorder while the benchmarked programs stay uninstrumented
+    # (traced_hooks=False — no callbacks, no retrace, no inserted ops)
+    rec = monitor.Recorder(name="bench", capacity=16384,
+                           traced_hooks=False)
+    monitor.trace.install_compile_logging()
+    monitor.attach(rec)
     try:
         o2_ips, o2_dt, o2_flops, o2_iqr, o2_disp = _time_steps(
             "O2", want_flops=True, want_dispatch=True)
@@ -1105,6 +1195,10 @@ def main():
         except Exception as e:
             extras["dispatch_overhead_error"] = \
                 f"{type(e).__name__}: {e}"[:120]
+        try:
+            extras.update(_monitor_extras(rec))
+        except Exception as e:
+            extras["monitor_error"] = f"{type(e).__name__}: {e}"[:120]
         import jax
         print(json.dumps({
             "metric": "resnet50_O2_train_throughput",
@@ -1125,6 +1219,8 @@ def main():
             "error": f"{type(e).__name__}: {e}"[:300],
         }))
         raise
+    finally:
+        monitor.detach()
 
 
 if __name__ == "__main__":

@@ -34,6 +34,10 @@ def main():
                    help='"dynamic" or a float (opt-level default otherwise)')
     p.add_argument("--steps", type=int, default=200)
     p.add_argument("--batch", type=int, default=512)
+    p.add_argument("--monitor", default=None, metavar="RUN_JSONL",
+                   help="attach an apex_tpu.monitor recorder and dump "
+                        "per-step telemetry here (render with "
+                        "`python -m apex_tpu.monitor report RUN_JSONL`)")
     args = p.parse_args()
 
     n_dev = jax.device_count()
@@ -84,14 +88,25 @@ def main():
         in_specs=(P(), P(), P(), P("data"), P("data")),
         out_specs=(P(), P(), P(), P()), check_vma=False))
 
-    for i in range(args.steps):
-        x = jnp.asarray(x_all[i])
-        y = jnp.asarray(y_all[i])
-        params, opt_state, sstate, loss = sharded_step(
-            params, opt_state, sstate, x, y)
-        if i % 50 == 0 or i == args.steps - 1:
-            print(f"step {i:4d}  loss {float(loss):.6f}  "
-                  f"scale {float(sstate.loss_scale):.0f}")
+    # optional telemetry: attach BEFORE the first (tracing) call so the
+    # trace-time hooks — dp collective accounting, loss-scale gauges —
+    # land in the recorder (docs/observability.md)
+    import contextlib
+    from apex_tpu import monitor
+    rec = monitor.Recorder(name="simple-amp") if args.monitor else None
+    with (monitor.attached(rec) if rec else contextlib.nullcontext()):
+        for i in range(args.steps):
+            x = jnp.asarray(x_all[i])
+            y = jnp.asarray(y_all[i])
+            with (rec.step() if rec else contextlib.nullcontext()):
+                params, opt_state, sstate, loss = sharded_step(
+                    params, opt_state, sstate, x, y)
+            if i % 50 == 0 or i == args.steps - 1:
+                print(f"step {i:4d}  loss {float(loss):.6f}  "
+                      f"scale {float(sstate.loss_scale):.0f}")
+    if rec is not None:
+        rec.dump_jsonl(args.monitor)
+        print(f"telemetry: {len(rec.records())} events -> {args.monitor}")
     assert float(loss) < 1e-2, f"did not converge: {float(loss)}"
     print("converged ok")
 

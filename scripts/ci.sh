@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# The full CI gate: static analysis, tier-1 tests, and the monitor
+# telemetry selfcheck — one command, fail-fast, suitable as-is for a PR
+# gate.
+#
+#   scripts/ci.sh                 # everything
+#   CI_SKIP_TESTS=1 scripts/ci.sh # lint + selfcheck only (quick loop)
+#
+# Stages:
+#   1. lint        — scripts/lint.sh (AST rules APX001-APX006 + the
+#                    traced-entrypoint collective-axis checks, which
+#                    include the monitor-instrumented amp step)
+#   2. tier-1      — the ROADMAP tier-1 pytest command (CPU, 8 virtual
+#                    devices, not-slow subset, 870 s budget)
+#   3. selfcheck   — python -m apex_tpu.monitor selfcheck: records a
+#                    synthetic 3-step amp run with a recorder attached
+#                    and asserts the JSONL dump -> report round trip
+#                    (per-step loss-scale/grad-norm/step-time fields,
+#                    disabled-mode jaxpr purity)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+fail=0
+
+echo "== ci: lint =="
+LINT_JAXPR=1 bash scripts/lint.sh || fail=1
+
+if [[ "${CI_SKIP_TESTS:-0}" != "1" ]]; then
+  echo "== ci: tier-1 tests =="
+  ( set -o pipefail; rm -f /tmp/_t1.log; \
+    timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+      -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+      -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log ) || fail=1
+fi
+
+echo "== ci: monitor selfcheck =="
+JAX_PLATFORMS=cpu python -m apex_tpu.monitor selfcheck --quiet || fail=1
+
+if [[ "$fail" == "0" ]]; then
+  echo "ci: all gates green"
+else
+  echo "ci: FAILED (see above)"
+fi
+exit $fail

@@ -68,6 +68,67 @@ def test_stateful_wrapper_and_checkpoint():
     assert sc2.loss_scale() == 128.0
 
 
+def test_state_summary_overflow_skip_regrowth_sequence():
+    """The full dynamic trajectory through the public state_summary()
+    dict (no private attrs): overflow → skip (scale halves, counter
+    resets), clean window → regrowth (scale doubles, reset counted),
+    repeated overflows accumulate in skipped_steps."""
+    sc = S.LossScaler("dynamic", init_scale=1024.0, scale_window=3)
+    st = sc.state_summary()
+    assert st["scale"] == 1024.0 and st["growth_counter"] == 0
+    assert st["skipped_steps"] == 0 and st["dynamic"]
+
+    # overflow: skip, halve, growth counter resets
+    assert sc.update_scale(found_inf=True)
+    st = sc.state_summary()
+    assert st["scale"] == 512.0 and st["growth_counter"] == 0
+    assert st["skipped_steps"] == 1 and st["overflow"]
+
+    # two clean steps: counter climbs, scale holds
+    for expect in (1, 2):
+        assert not sc.update_scale(found_inf=False)
+        assert sc.state_summary()["growth_counter"] == expect
+        assert sc.state_summary()["scale"] == 512.0
+
+    # third clean step completes the window: regrowth + counter reset
+    assert not sc.update_scale(found_inf=False)
+    st = sc.state_summary()
+    assert st["scale"] == 1024.0 and st["growth_counter"] == 0
+    assert st["growth_interval_resets"] == 1
+
+    # immediate second overflow: total skipped accumulates
+    assert sc.update_scale(found_inf=True)
+    st = sc.state_summary()
+    assert st["scale"] == 512.0 and st["skipped_steps"] == 2
+
+    # knobs surface in the summary (the former private attrs)
+    assert st["scale_window"] == 3 and st["scale_factor"] == 2.0
+    assert st["max_loss_scale"] == 2.0 ** 24
+
+
+def test_state_summary_static_scaler():
+    sc = S.LossScaler(128.0)
+    sc.update_scale(found_inf=True)     # static: records skip, no change
+    st = sc.state_summary()
+    assert st["scale"] == 128.0 and not st["dynamic"]
+    assert st["skipped_steps"] == 1 and st["growth_interval_resets"] == 0
+
+
+def test_state_dict_roundtrips_skipped_steps():
+    sc = S.LossScaler("dynamic", init_scale=256.0, scale_window=1)
+    sc.update_scale(found_inf=True)
+    sc.update_scale(found_inf=True)
+    sc.update_scale(found_inf=False)    # window=1: immediate regrowth
+    sd = sc.state_dict()
+    assert sd["skipped_steps"] == 2
+    assert sd["growth_interval_resets"] == 1
+    sc2 = S.LossScaler("dynamic")
+    sc2.load_state_dict(sd)
+    assert sc2.state_summary()["skipped_steps"] == 2
+    assert sc2.state_summary()["growth_interval_resets"] == 1
+    assert sc2.loss_scale() == 128.0    # 256 → 128 → 64 → regrow 128
+
+
 def test_sync_found_inf_across_tp():
     """tp ranks see different grad shards; sync_found_inf must make them
     agree on skip-vs-apply (one rank's inf flags the whole group)."""
