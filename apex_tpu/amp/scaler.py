@@ -63,9 +63,13 @@ def unscale(grads: Any, state: ScalerState, out_dtype=jnp.float32):
         # consumed held inf, poisoning params with no skip (caught by
         # the r5 convergence tier at step 0). The barrier pins ONE
         # materialization of the fp16 grads that both the detection and
-        # the update then share. bf16/fp32 paths skip it (no fp16
-        # rounding ambiguity; the barrier would only block fusion).
-        grads = jax.lax.optimization_barrier(grads)
+        # the update then share, and is applied PER LEAF to only the
+        # fp16 leaves: bf16/fp32 leaves in a mixed tree (master-weight
+        # setups, fp32-pinned batchnorm grads) have no fp16 rounding
+        # ambiguity, and barriering them would only block their fusion.
+        grads = jax.tree.map(
+            lambda g: jax.lax.optimization_barrier(g)
+            if g.dtype == jnp.float16 else g, grads)
     found_inf = ~tree_all_finite(grads)
     out = jax.tree.map(
         lambda g: (g.astype(jnp.float32) * inv).astype(out_dtype)

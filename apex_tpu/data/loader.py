@@ -333,6 +333,11 @@ class DataLoader:
                 if _mon.enabled():
                     _mon.timer_event("data/host_wait",
                                      time.perf_counter() - t_wait)
+                    # backlog after the take — supporting context for
+                    # starvation triage (the health watchdog's
+                    # loader_starvation detection keys on the
+                    # data/host_wait timer vs step time, not this)
+                    _mon.gauge("data/prefetch_depth", q.qsize())
                 if item is None:
                     return
                 _mon.counter("data/batches")

@@ -2,11 +2,17 @@
 
 The observability subsystem the reference never had on TPU: a typed-event
 :class:`Recorder` (counters, gauges, timers, per-step records in a ring
-buffer, JSONL/JSON output), instrumentation hooks threaded through amp,
-optimizers, the collective mappings, the pipeline schedules and the data
-loader, a trace layer subsuming ``apex_tpu.pyprof`` (XProf annotations,
-compile-event and jit-cache logging, device-memory snapshots), and a CLI
-report (``python -m apex_tpu.monitor report run.jsonl``).
+buffer, JSONL/JSON output, crash-resilient ``stream=`` incremental
+flush), instrumentation hooks threaded through amp, optimizers, the
+collective mappings, the pipeline schedules and the data loader, a
+trace layer subsuming ``apex_tpu.pyprof`` (XProf annotations,
+compile-event and jit-cache logging, device-memory snapshots), a
+cross-host merge layer (``monitor.merge``: rank-tagged shards +
+``python -m apex_tpu.monitor merge`` + in-mesh ``allgather_summaries``),
+a training-health :class:`Watchdog` (``monitor.health``: NaN/overflow-
+storm/divergence/plateau/starvation/straggler detection as typed
+``health_event`` records), and a CLI report
+(``python -m apex_tpu.monitor report run.jsonl``).
 
 Quick start::
 
@@ -40,12 +46,16 @@ from __future__ import annotations
 import contextlib
 
 from apex_tpu.monitor import _state
+from apex_tpu.monitor import health  # noqa: F401
 from apex_tpu.monitor import hooks  # noqa: F401
+from apex_tpu.monitor import merge  # noqa: F401
 from apex_tpu.monitor import trace  # noqa: F401
 from apex_tpu.monitor import xprof  # noqa: F401
+from apex_tpu.monitor.health import Watchdog  # noqa: F401
 from apex_tpu.monitor.recorder import Recorder  # noqa: F401
 from apex_tpu.monitor.report import (  # noqa: F401
-    aggregate, load_jsonl, render_report, render_steps, selfcheck)
+    aggregate, load_jsonl, render_cross_host, render_report, render_steps,
+    selfcheck)
 from apex_tpu.monitor.hooks import enabled, epoch  # noqa: F401
 
 

@@ -1,11 +1,16 @@
 """CLI: render recorder dumps and smoke-test the telemetry pipeline.
 
     python -m apex_tpu.monitor report run.jsonl [--json] [--max-rows N]
+    python -m apex_tpu.monitor merge SHARD... [--json] [-o OUT.json]
     python -m apex_tpu.monitor selfcheck [--steps N]
 
 ``report`` renders the per-step and aggregate tables from a
 ``Recorder.dump_jsonl`` file (the ``pyprof.prof`` analog — per-step
-training telemetry instead of per-kernel nvprof records). ``selfcheck``
+training telemetry instead of per-kernel nvprof records). ``merge``
+combines rank-tagged shards (``monitor-<rank>.jsonl``, or a directory
+holding them) from a multi-process run into one cross-host view:
+collective bytes summed across ranks, per-rank timer distributions
+with straggler percentiles, per-rank step-time skew. ``selfcheck``
 records a synthetic 3-step amp run on CPU and asserts the dump → report
 round trip (used by ``scripts/ci.sh``).
 """
@@ -28,6 +33,17 @@ def main(argv=None) -> int:
     pr.add_argument("--max-rows", type=int, default=50,
                     help="per-step table row cap")
 
+    pm = sub.add_parser("merge",
+                        help="merge rank-tagged shards into a "
+                             "cross-host report")
+    pm.add_argument("shards", nargs="+",
+                    help="monitor-<rank>.jsonl files, or one directory "
+                         "containing them")
+    pm.add_argument("--json", action="store_true",
+                    help="print the merged view as JSON")
+    pm.add_argument("-o", "--out", default=None,
+                    help="also write the merged JSON here")
+
     ps = sub.add_parser("selfcheck",
                         help="record a synthetic run; assert round-trip")
     ps.add_argument("--steps", type=int, default=3)
@@ -36,14 +52,32 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     from apex_tpu.monitor import report as report_mod
 
+    from apex_tpu.monitor.recorder import json_safe
+
     if args.cmd == "report":
         header, events = report_mod.load_jsonl(args.path)
         if args.json:
-            print(json.dumps(report_mod.aggregate(events, header=header),
-                             indent=2))
+            print(json.dumps(
+                json_safe(report_mod.aggregate(events, header=header)),
+                indent=2))
         else:
             print(report_mod.render_report(events, header=header,
                                            max_rows=args.max_rows))
+        return 0
+
+    if args.cmd == "merge":
+        from apex_tpu.monitor import merge as merge_mod
+        shards = args.shards
+        if len(shards) == 1:
+            shards = shards[0]   # may be a directory; merge_shards resolves
+        merged = json_safe(merge_mod.merge_shards(shards))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(merged, f, indent=2)
+        if args.json:
+            print(json.dumps(merged, indent=2))
+        else:
+            print(report_mod.render_cross_host(merged))
         return 0
 
     # selfcheck needs a backend; default to CPU unless the caller chose

@@ -1,0 +1,359 @@
+"""Training-health watchdog over the Recorder event stream.
+
+The telemetry PR 2 built records what happened; this layer says what is
+*wrong*. A :class:`Watchdog` registers as a step observer on a
+:class:`~apex_tpu.monitor.recorder.Recorder` and scans every closed
+step record on the host for the conditions that actually kill
+mixed-precision distributed runs:
+
+- ``nan``                non-finite loss / grad-norm / any step gauge
+- ``overflow_storm``     the dynamic loss scale halving (or the
+                         overflow flag firing) >= N times in a window —
+                         grads are persistently non-finite, the scaler
+                         is treading water instead of recovering
+- ``loss_divergence``    loss blowing past ``divergence_factor`` x its
+                         best value after a grace period
+- ``loss_plateau``       loss flat (relative change < rtol) over a full
+                         window
+- ``loader_starvation``  ``data/host_wait`` eating more than a fraction
+                         of the step time for consecutive steps — the
+                         chip is waiting on the input pipeline
+- ``straggler``          (cross-host, via :meth:`Watchdog.
+                         check_cross_host` on a ``merge`` view) a rank
+                         whose median step time exceeds the global
+                         median by ``straggler_ratio``
+
+Each detection emits one typed ``health_event`` record into the
+recorder — ``{"kind": "health_event", "name": <condition>, "severity",
+"diagnosis", ...}`` — which rides the JSONL dump, shows up in
+``python -m apex_tpu.monitor report``, and (when the recorder streams)
+is flushed to disk immediately. ``on_event`` lets the training loop
+react, e.g. dump :meth:`Watchdog.diagnostics_bundle` and abort.
+
+Everything here is host-side Python over already-recorded events: the
+watchdog inserts no ops, forces no retrace, and costs nothing when
+monitoring is detached (the disabled-mode purity guarantee of
+docs/observability.md is untouched).
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from typing import Callable, Optional
+
+HEALTH_EVENT_KINDS = (
+    "nan", "overflow_storm", "loss_divergence", "loss_plateau",
+    "loader_starvation", "straggler",
+)
+
+
+def _finite(v) -> bool:
+    try:
+        return math.isfinite(float(v))
+    except (TypeError, ValueError):
+        return True   # non-numeric gauges are not NaN signals
+
+
+class Watchdog:
+    """Online health analysis of a recorder's step stream.
+
+    Usage::
+
+        rec = monitor.Recorder()
+        dog = monitor.Watchdog(rec, on_event=my_handler)
+        with monitor.attached(rec):
+            for batch in loader:
+                with rec.step():
+                    state = train_step(state, batch)
+        # dog.events holds every health_event; they are also in
+        # rec.records("health_event") and the rendered report.
+
+    All thresholds are keyword-configurable. ``loss_gauges`` names the
+    gauges tried (in order) as "the loss" for plateau/divergence
+    tracking; NaN detection scans *every* gauge on the step record.
+    """
+
+    def __init__(self, recorder=None, *,
+                 on_event: Optional[Callable] = None,
+                 loss_gauges=("train/loss", "loss"),
+                 overflow_window: int = 20, overflow_trips: int = 3,
+                 divergence_factor: float = 3.0,
+                 divergence_grace: int = 10,
+                 divergence_patience: int = 3,
+                 divergence_smoothing: float = 0.2,
+                 plateau_window: int = 50, plateau_rtol: float = 1e-3,
+                 starvation_fraction: float = 0.5,
+                 starvation_window: int = 5,
+                 straggler_ratio: float = 1.5,
+                 diagnostics_steps: int = 16,
+                 scaler=None):
+        self.on_event = on_event
+        self.loss_gauges = tuple(loss_gauges)
+        self.overflow_window = int(overflow_window)
+        self.overflow_trips = int(overflow_trips)
+        self.divergence_factor = float(divergence_factor)
+        self.divergence_grace = int(divergence_grace)
+        self.divergence_patience = int(divergence_patience)
+        self.divergence_smoothing = float(divergence_smoothing)
+        self.plateau_window = int(plateau_window)
+        self.plateau_rtol = float(plateau_rtol)
+        self.starvation_fraction = float(starvation_fraction)
+        self.starvation_window = int(starvation_window)
+        self.straggler_ratio = float(straggler_ratio)
+        self.diagnostics_steps = int(diagnostics_steps)
+        self.scaler = scaler            # optional LossScaler for bundles
+        self.events: list[dict] = []
+        self.recorder = None
+        # detection state
+        self._nan_seen: set = set()
+        self._overflow_hist: collections.deque = collections.deque(
+            maxlen=self.overflow_window)
+        self._overflow_active = False
+        self._prev_scale: Optional[float] = None
+        self._loss_hist: collections.deque = collections.deque(
+            maxlen=self.plateau_window)
+        self._best_loss: Optional[float] = None
+        self._loss_ema: Optional[float] = None   # divergence smoother
+        self._best_ema: Optional[float] = None
+        self._div_run = 0          # consecutive steps above the bar
+        self._diverged = False
+        self._plateaued = False
+        self._starve_hist: collections.deque = collections.deque(
+            maxlen=self.starvation_window)
+        self._starving = False
+        self._n_steps = 0
+        if recorder is not None:
+            self.watch(recorder)
+
+    # -- wiring -------------------------------------------------------------
+    def watch(self, recorder):
+        """Register on ``recorder``'s step stream; returns the recorder
+        (so ``monitor.attached(dog.watch(rec))`` composes)."""
+        recorder.add_observer(self._on_step)
+        self.recorder = recorder
+        return recorder
+
+    def unwatch(self):
+        if self.recorder is not None:
+            self.recorder.remove_observer(self._on_step)
+            self.recorder = None
+
+    # -- event emission -----------------------------------------------------
+    def _fire(self, rec, name: str, value, diagnosis: str,
+              severity: str = "warn", **details) -> dict:
+        ev = rec.emit("health_event", name, value, severity=severity,
+                      diagnosis=diagnosis, **details)
+        self.events.append(ev)
+        if self.on_event is not None:
+            try:
+                self.on_event(ev)
+            except Exception:
+                pass
+        return ev
+
+    # -- per-step analysis --------------------------------------------------
+    def _on_step(self, step_ev: dict, rec):
+        self._n_steps += 1
+        step = step_ev.get("step")
+        gauges = step_ev.get("gauges") or {}
+
+        # 1) non-finite values anywhere on the step record (once/gauge)
+        for gname, v in gauges.items():
+            if not _finite(v) and gname not in self._nan_seen:
+                self._nan_seen.add(gname)
+                self._fire(
+                    rec, "nan", v if isinstance(v, (int, float)) else None,
+                    f"non-finite value in gauge '{gname}' at step {step} "
+                    f"({v!r}). A NaN/inf loss or grad norm usually means "
+                    "optimizer divergence (lr too high / missing warmup) "
+                    "or fp16 overflow with loss scaling disabled — check "
+                    "the optim/grad_norm trend and the amp/loss_scale "
+                    "history leading up to this step.",
+                    severity="error", gauge=gname, step=step)
+
+        # 2) overflow storm: scale halvings / overflow flags in a window
+        scale = gauges.get("amp/loss_scale")
+        overflow = gauges.get("amp/overflow")
+        tripped = bool(overflow) and _finite(overflow) and \
+            float(overflow) != 0.0
+        if not tripped and scale is not None and _finite(scale) \
+                and self._prev_scale is not None and _finite(self._prev_scale):
+            tripped = float(scale) < float(self._prev_scale)
+        if scale is not None:
+            self._prev_scale = scale
+        if scale is not None or overflow is not None:
+            self._overflow_hist.append(1 if tripped else 0)
+            trips = sum(self._overflow_hist)
+            if trips >= self.overflow_trips and not self._overflow_active:
+                self._overflow_active = True
+                self._fire(
+                    rec, "overflow_storm", trips,
+                    f"loss scale tripped {trips}x in the last "
+                    f"{len(self._overflow_hist)} steps (scale now "
+                    f"{scale}): gradients are persistently non-finite "
+                    "and the dynamic scaler is shrinking instead of "
+                    "recovering. Typical causes: lr too high for the "
+                    "half dtype, a non-finite input batch, or a "
+                    "min_loss_scale floor set too high.",
+                    severity="error", step=step, loss_scale=scale,
+                    window=len(self._overflow_hist))
+            elif trips == 0:
+                self._overflow_active = False
+
+        # 3) loss divergence / plateau
+        loss = None
+        loss_name = None
+        for cand in self.loss_gauges:
+            if cand in gauges:
+                loss, loss_name = gauges[cand], cand
+                break
+        if loss is not None and _finite(loss):
+            loss = float(loss)
+            if self._best_loss is None or loss < self._best_loss:
+                self._best_loss = loss
+            # divergence runs on an EMA of the loss, not the raw value:
+            # healthy early training with momentum oscillates (a 1.1 ->
+            # 7.7 -> falling overshoot was measured on the simple
+            # example), and a spike that decays must not page anyone.
+            # Genuine divergence moves the EMA orders of magnitude in a
+            # step or two and still fires immediately.
+            a = self.divergence_smoothing
+            self._loss_ema = loss if self._loss_ema is None else \
+                (1.0 - a) * self._loss_ema + a * loss
+            if self._best_ema is None or self._loss_ema < self._best_ema:
+                self._best_ema = self._loss_ema
+                self._div_run = 0
+            elif (self._n_steps > self.divergence_grace
+                  and self._best_ema > 0
+                  and self._loss_ema
+                  > self.divergence_factor * self._best_ema):
+                self._div_run += 1
+                if (self._div_run >= self.divergence_patience
+                        and not self._diverged):
+                    self._diverged = True
+                    self._fire(
+                        rec, "loss_divergence", loss,
+                        f"'{loss_name}' at step {step}: smoothed loss "
+                        f"{self._loss_ema:.4g} >= "
+                        f"{self.divergence_factor}x its best "
+                        f"{self._best_ema:.4g} for {self._div_run} "
+                        "consecutive steps: the run is diverging. Lower "
+                        "the learning rate, add warmup, or check the "
+                        "grad-norm trend for an exploding layer.",
+                        severity="error", step=step, gauge=loss_name,
+                        best=self._best_ema)
+            else:
+                self._div_run = 0
+            self._loss_hist.append(loss)
+            if (len(self._loss_hist) == self.plateau_window
+                    and not self._plateaued and not self._diverged):
+                half = self.plateau_window // 2
+                hist = list(self._loss_hist)
+                a = sum(hist[:half]) / half
+                b = sum(hist[half:]) / (len(hist) - half)
+                denom = max(abs(a), 1e-12)
+                if abs(a - b) / denom < self.plateau_rtol:
+                    self._plateaued = True
+                    self._fire(
+                        rec, "loss_plateau", loss,
+                        f"'{loss_name}' flat over the last "
+                        f"{self.plateau_window} steps "
+                        f"({a:.4g} -> {b:.4g}, relative change < "
+                        f"{self.plateau_rtol:g}): training has stalled "
+                        "— converged, lr decayed to zero, or the "
+                        "optimizer is skipping every step (check "
+                        "amp/skipped_steps).",
+                        severity="info", step=step, gauge=loss_name)
+
+        # 4) data-loader starvation: host wait as a fraction of step time
+        step_s = float(step_ev.get("step_time_s") or 0.0)
+        wait = (step_ev.get("timers") or {}).get("data/host_wait")
+        if wait is not None and step_s > 0:
+            frac = float(wait.get("total_s", 0.0)) / step_s
+            self._starve_hist.append(frac)
+            if (len(self._starve_hist) == self.starvation_window
+                    and min(self._starve_hist) >= self.starvation_fraction):
+                if not self._starving:
+                    self._starving = True
+                    self._fire(
+                        rec, "loader_starvation", round(frac, 4),
+                        f"data/host_wait took {100 * frac:.0f}% of the "
+                        f"step for {self.starvation_window} consecutive "
+                        "steps: the accelerator is starving on the "
+                        "input pipeline. Raise loader workers/prefetch "
+                        "or move transforms off the hot path.",
+                        severity="warn", step=step,
+                        window=self.starvation_window)
+            elif self._starve_hist and self._starve_hist[-1] \
+                    < self.starvation_fraction:
+                self._starving = False
+
+    # -- cross-host ---------------------------------------------------------
+    def check_cross_host(self, merged: dict, recorder=None) -> list[dict]:
+        """Scan a ``merge`` cross-host view for straggler ranks: any
+        rank whose median step time exceeds ``straggler_ratio`` x the
+        global median. Emits one ``straggler`` health_event per flagged
+        rank into ``recorder`` (default: the watched recorder) and
+        returns the events. Host-wait stragglers (per-timer
+        ``max_over_median``) are reported on the same event."""
+        rec = recorder if recorder is not None else self.recorder
+        events = []
+        skew = (merged.get("steps") or {}).get("skew") or {}
+        ratios = skew.get("per_rank_ratio") or {}
+        waits = (merged.get("timers") or {}).get("data/host_wait") or {}
+        for rank, ratio in sorted(ratios.items()):
+            if ratio is None or ratio < self.straggler_ratio:
+                continue
+            diag = (f"rank {rank} median step time is {ratio}x the "
+                    f"global median ({skew.get('median_step_time_s')}s)"
+                    ": straggler rank — slow host, contended NIC, or an "
+                    "input-pipeline stall on that host.")
+            wait_row = (waits.get("by_rank") or {}).get(str(rank))
+            if wait_row is not None and waits.get("slowest_rank") is not None \
+                    and str(waits["slowest_rank"]) == str(rank):
+                diag += (" Its data/host_wait mean is also the fleet max "
+                         f"({wait_row.get('mean_s')}s) — the input "
+                         "pipeline is the likely cause.")
+            details = {"rank": int(rank), "ratio": ratio, "severity": "warn",
+                       "diagnosis": diag}
+            if rec is not None:
+                events.append(self._fire(
+                    rec, "straggler", ratio, diag, severity="warn",
+                    rank=int(rank)))
+            else:
+                ev = {"kind": "health_event", "name": "straggler",
+                      "value": ratio, **details}
+                self.events.append(ev)
+                events.append(ev)
+                if self.on_event is not None:
+                    try:
+                        self.on_event(ev)
+                    except Exception:
+                        pass
+        return events
+
+    # -- diagnostics --------------------------------------------------------
+    def diagnostics_bundle(self, k: Optional[int] = None) -> dict:
+        """Snapshot for post-mortems: the last-K step records, current
+        gauges/counters, every health event so far, the scaler state
+        summary (when a scaler was registered), and a per-device memory
+        snapshot (best-effort; empty off-accelerator)."""
+        k = self.diagnostics_steps if k is None else int(k)
+        bundle: dict = {"health_events": list(self.events)}
+        rec = self.recorder
+        if rec is not None:
+            bundle["last_steps"] = rec.steps()[-k:]
+            bundle["gauges"] = rec.gauges()
+            bundle["counters"] = rec.counters()
+        if self.scaler is not None:
+            try:
+                bundle["scaler"] = self.scaler.state_summary()
+            except Exception:
+                pass
+        try:
+            from apex_tpu.monitor import trace as _trace
+            bundle["device_memory"] = _trace.device_memory_snapshot()
+        except Exception:
+            bundle["device_memory"] = []
+        return bundle

@@ -1,0 +1,238 @@
+"""Cross-host telemetry aggregation: rank-tagged shards + merged view.
+
+One process can only see its own Recorder; a production run has one
+Recorder per host. This module makes the mesh-wide picture:
+
+- **Shards**: every process dumps its recorder to
+  ``monitor-{process_index}.jsonl`` (:func:`dump_shard` — rank and
+  world size land in the header ``meta``). Shards are ordinary
+  ``Recorder.dump_jsonl`` files, so each one also renders standalone.
+- **Offline merge**: ``python -m apex_tpu.monitor merge <shards...>``
+  (or :func:`merge_shards`) combines shards into one cross-host view —
+  collective bytes/counts summed across ranks per ``op@axis``, counters
+  summed, timers kept as per-rank distributions with straggler
+  percentiles (max/median of the per-rank means), and per-rank
+  step-time skew (each rank's median step time over the global median,
+  slowest rank named).
+- **In-mesh merge**: :func:`allgather_summaries` produces the same
+  merged view *inside* a multi-process run using host collectives
+  (``process_allgather`` of each rank's JSON summary). Guarded to be
+  free when monitoring is detached: no recorder → returns ``None``
+  without importing jax.
+
+The merged dict is what :func:`apex_tpu.monitor.report.
+render_cross_host` renders and what ``health.Watchdog.check_cross_host``
+scans for straggler ranks.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Iterable, Optional, Sequence
+
+from apex_tpu.monitor import _state
+from apex_tpu.monitor.report import aggregate, load_jsonl
+
+SHARD_RE = re.compile(r"monitor-(\d+)\.jsonl$")
+
+
+def shard_path(directory: str, process_index: int) -> str:
+    """The rank-tagged shard file for one process."""
+    return os.path.join(directory, f"monitor-{int(process_index)}.jsonl")
+
+
+def dump_shard(recorder, directory: str, process_index: Optional[int] = None,
+               process_count: Optional[int] = None) -> str:
+    """Dump ``recorder`` as this process's shard under ``directory``.
+
+    ``process_index``/``process_count`` default to the jax distributed
+    runtime's values (the only jax touch in this module, and only when
+    the caller does not supply them)."""
+    if process_index is None or process_count is None:
+        import jax
+        if process_index is None:
+            process_index = jax.process_index()
+        if process_count is None:
+            process_count = jax.process_count()
+    recorder.meta["process_index"] = int(process_index)
+    recorder.meta["process_count"] = int(process_count)
+    os.makedirs(directory, exist_ok=True)
+    path = shard_path(directory, process_index)
+    recorder.dump_jsonl(path)
+    return path
+
+
+def find_shards(directory: str) -> list[str]:
+    """All ``monitor-<rank>.jsonl`` files in ``directory``, rank order."""
+    paths = glob.glob(os.path.join(directory, "monitor-*.jsonl"))
+    tagged = [(int(SHARD_RE.search(p).group(1)), p)
+              for p in paths if SHARD_RE.search(p)]
+    return [p for _, p in sorted(tagged)]
+
+
+def rank_summary(header: dict, events: Iterable[dict],
+                 rank: Optional[int] = None) -> dict:
+    """One rank's aggregate, tagged with its process index (taken from
+    the shard header meta when not given)."""
+    if rank is None:
+        rank = (header or {}).get("meta", {}).get("process_index", 0)
+    return {"rank": int(rank), "aggregate": aggregate(events, header=header)}
+
+
+def _dist(xs: Sequence[float]) -> dict:
+    xs = sorted(xs)
+    n = len(xs)
+    med = xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+    return {"n": n, "min": xs[0], "max": xs[-1], "median": med,
+            "mean": sum(xs) / n}
+
+
+def merge_summaries(summaries: Sequence[dict]) -> dict:
+    """Combine per-rank summaries (:func:`rank_summary`) into the
+    cross-host view (module docstring). Pure stdlib."""
+    summaries = sorted(summaries, key=lambda s: s["rank"])
+    ranks = [s["rank"] for s in summaries]
+    out: dict = {"kind": "cross_host", "n_ranks": len(summaries),
+                 "ranks": ranks}
+
+    # collectives: bytes/counts summed across ranks, per-rank kept
+    coll_sum: dict[str, dict] = {}
+    coll_by_rank: dict[str, dict] = {}
+    counters: dict[str, float] = {}
+    for s in summaries:
+        agg = s["aggregate"]
+        coll_by_rank[str(s["rank"])] = agg.get("collectives", {})
+        for k, v in agg.get("collectives", {}).items():
+            slot = coll_sum.setdefault(k, {"count": 0, "bytes": 0})
+            slot["count"] += int(v.get("count", 0))
+            slot["bytes"] += int(v.get("bytes", 0))
+        for k, v in agg.get("counters", {}).items():
+            counters[k] = counters.get(k, 0) + v
+    out["collectives"] = {k: coll_sum[k] for k in sorted(coll_sum)}
+    out["collectives_by_rank"] = coll_by_rank
+    out["counters"] = {k: counters[k] for k in sorted(counters)}
+
+    # timers: per-rank distributions + straggler percentiles over the
+    # per-rank means (a rank whose data/host_wait mean is 3x the median
+    # is the input-starved straggler)
+    timer_names = sorted({n for s in summaries
+                          for n in s["aggregate"].get("timers", {})})
+    timers: dict[str, dict] = {}
+    for name in timer_names:
+        by_rank = {}
+        means = {}
+        for s in summaries:
+            t = s["aggregate"].get("timers", {}).get(name)
+            if t:
+                by_rank[str(s["rank"])] = t
+                means[s["rank"]] = float(t.get("mean_s", 0.0))
+        row: dict = {"by_rank": by_rank}
+        if means:
+            d = _dist(list(means.values()))
+            slowest = max(means, key=means.get)
+            row.update({
+                "mean_s_median": round(d["median"], 6),
+                "mean_s_max": round(d["max"], 6),
+                "max_over_median": round(d["max"] / d["median"], 3)
+                if d["median"] > 0 else None,
+                "slowest_rank": slowest,
+            })
+        timers[name] = row
+    out["timers"] = timers
+
+    # steps: per-rank step-time distributions + skew per rank
+    step_by_rank = {}
+    medians = {}
+    for s in summaries:
+        st = s["aggregate"].get("steps")
+        if st:
+            step_by_rank[str(s["rank"])] = dict(st["step_time_s"],
+                                                count=st["count"])
+            medians[s["rank"]] = float(st["step_time_s"]["median"])
+    if medians:
+        global_med = _dist(list(medians.values()))["median"]
+        skew = {str(r): round(m / global_med, 3) if global_med > 0 else None
+                for r, m in medians.items()}
+        slowest = max(medians, key=medians.get)
+        out["steps"] = {
+            "by_rank": step_by_rank,
+            "skew": {
+                "median_step_time_s": round(global_med, 6),
+                "max_step_time_s": round(max(medians.values()), 6),
+                "max_over_median": round(
+                    max(medians.values()) / global_med, 3)
+                if global_med > 0 else None,
+                "per_rank_ratio": skew,
+                "slowest_rank": slowest,
+            },
+        }
+
+    # last-value gauges and health events stay rank-scoped (a loss-scale
+    # gauge has no meaningful cross-rank sum)
+    out["gauges_by_rank"] = {str(s["rank"]): s["aggregate"].get("gauges", {})
+                             for s in summaries}
+    health = []
+    for s in summaries:
+        for ev in s["aggregate"].get("health", []):
+            health.append({**ev, "rank": s["rank"]})
+    if health:
+        out["health_events"] = health
+    return out
+
+
+def merge_shards(paths_or_dir) -> dict:
+    """Load shard files (or every ``monitor-*.jsonl`` in a directory)
+    and merge them into the cross-host view."""
+    if isinstance(paths_or_dir, str):
+        paths = find_shards(paths_or_dir) if os.path.isdir(paths_or_dir) \
+            else [paths_or_dir]
+    else:
+        paths = list(paths_or_dir)
+    if not paths:
+        raise ValueError("no monitor shards found")
+    summaries = []
+    for i, p in enumerate(paths):
+        header, events = load_jsonl(p)
+        rank = (header.get("meta") or {}).get("process_index")
+        if rank is None:
+            m = SHARD_RE.search(str(p))
+            rank = int(m.group(1)) if m else i
+        summaries.append(rank_summary(header, events, rank=rank))
+    return merge_summaries(summaries)
+
+
+def allgather_summaries(recorder=None) -> Optional[dict]:
+    """In-mesh merge: gather every process's local summary with host
+    collectives and return the merged cross-host view on all ranks.
+
+    Free when detached: with no recorder attached (and none passed)
+    this returns ``None`` without importing jax or touching the mesh —
+    safe to leave in production loops unconditionally. With one process
+    it degenerates to a local merge. The gather is a *host* collective
+    (``multihost_utils.process_allgather``), so it runs outside any
+    compiled program and perturbs nothing that is being timed.
+    """
+    rec = recorder if recorder is not None else _state.recorder
+    if rec is None:
+        return None
+    import jax
+    import numpy as np
+    rank = jax.process_index()
+    local = rank_summary({"meta": rec.meta}, rec.records(), rank=rank)
+    if jax.process_count() == 1:
+        return merge_summaries([local])
+    from jax.experimental import multihost_utils
+    payload = np.frombuffer(json.dumps(local).encode("utf-8"), np.uint8)
+    # ragged gather: lengths first, then zero-padded payloads
+    lens = np.asarray(multihost_utils.process_allgather(
+        np.asarray([payload.size], np.int64))).reshape(-1)
+    padded = np.zeros(int(lens.max()), np.uint8)
+    padded[:payload.size] = payload
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    summaries = [
+        json.loads(bytes(gathered[i, :int(lens[i])]).decode("utf-8"))
+        for i in range(gathered.shape[0])]
+    return merge_summaries(summaries)

@@ -22,6 +22,19 @@ counts evictions), so a recorder attached for a million steps holds
 memory constant. Aggregation (:meth:`aggregate`) and the CLI report
 (``python -m apex_tpu.monitor report``) consume the JSONL dump.
 
+Crash resilience: pass ``stream=<path or file>`` and every event is
+ALSO appended to that file as one JSON line the moment it is emitted
+(write + flush, so the line survives the process being killed). A run
+that times out or crashes mid-step leaves a parseable JSONL holding
+everything recorded up to the kill — this is what ``bench.py`` builds
+its streaming evidence on, and what ``dump_shard`` rank-tagged shards
+use on multi-host runs.
+
+Observers: :meth:`add_observer` registers a host callback invoked with
+every closed ``step`` record — the hook :class:`~apex_tpu.monitor.
+health.Watchdog` uses to analyze the stream online without polling.
+Observer exceptions are swallowed (telemetry must never kill training).
+
 Threading: hooks may fire from loader worker threads and from runtime
 callback threads; all mutation happens under one lock.
 """
@@ -34,7 +47,32 @@ import json
 import sys
 import threading
 import time
-from typing import Any, Iterable, Optional
+from typing import Any, Callable, Iterable, Optional
+
+
+def json_safe(obj):
+    """Recursively replace non-finite floats with their string form
+    ("NaN"/"Infinity"/"-Infinity"). Bare ``json.dumps`` emits literal
+    ``NaN`` tokens — invalid strict JSON that jq/JSON.parse-style
+    drivers reject — on exactly the runs the watchdog exists for (a
+    NaN loss gauge). Strings keep the information and stay parseable;
+    ``float("NaN")`` round-trips for consumers that want the value."""
+    if isinstance(obj, float):
+        if obj != obj:
+            return "NaN"
+        if obj in (float("inf"), float("-inf")):
+            return "Infinity" if obj > 0 else "-Infinity"
+        return obj
+    if isinstance(obj, dict):
+        return {k: json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    return obj
+
+
+def json_line(obj) -> str:
+    """One strict-JSON line for an event dict (non-finite-safe)."""
+    return json.dumps(json_safe(obj))
 
 
 def _effects_barrier():
@@ -67,7 +105,8 @@ class Recorder:
     """
 
     def __init__(self, capacity: int = 65536, name: str = "run",
-                 meta: Optional[dict] = None, traced_hooks: bool = True):
+                 meta: Optional[dict] = None, traced_hooks: bool = True,
+                 stream=None, stream_mode: str = "w"):
         self.name = name
         self.capacity = int(capacity)
         self.meta = dict(meta or {})
@@ -86,9 +125,59 @@ class Recorder:
         self._lock = threading.RLock()
         self._step_idx = 0
         self._open_step: Optional[dict] = None
+        self._observers: list[Callable] = []
         self._t0 = time.perf_counter()
+        # incremental-flush stream: every event is appended + flushed as
+        # it is emitted, so a killed process leaves a parseable JSONL of
+        # everything recorded so far (module docstring)
+        self._stream = None
+        self._stream_owned = False
+        if stream is not None:
+            if hasattr(stream, "write"):
+                self._stream = stream
+            else:
+                self._stream = open(stream, stream_mode)
+                self._stream_owned = True
+            self._stream_write({"kind": "header", "name": self.name,
+                                "capacity": self.capacity, "dropped": 0,
+                                "meta": self.meta})
 
     # -- internals ---------------------------------------------------------
+    def _stream_write(self, ev: dict):
+        f = self._stream
+        if f is None:
+            return
+        try:
+            f.write(json_line(ev) + "\n")
+            f.flush()
+        except Exception:
+            pass   # telemetry must never kill the run
+
+    def close(self):
+        """Close an owned stream file (no-op otherwise)."""
+        with self._lock:
+            f, self._stream = self._stream, None
+            owned, self._stream_owned = self._stream_owned, False
+        if f is not None and owned:
+            try:
+                f.close()
+            except Exception:
+                pass
+
+    def add_observer(self, fn: Callable) -> Callable:
+        """Register ``fn(step_event, recorder)`` to run (on the host, in
+        the stepping thread) every time a ``step`` record closes. Errors
+        raised by observers are swallowed."""
+        with self._lock:
+            if fn not in self._observers:
+                self._observers.append(fn)
+        return fn
+
+    def remove_observer(self, fn: Callable):
+        with self._lock:
+            if fn in self._observers:
+                self._observers.remove(fn)
+
     def _emit(self, kind: str, name: str, value, **extra) -> dict:
         ev = {"kind": kind, "name": name, "value": value,
               "t": round(time.perf_counter() - self._t0, 6)}
@@ -99,7 +188,14 @@ class Recorder:
                 ev["step"] = self._open_step["step"]
             self._events.append(ev)
             self._emitted += 1
+            self._stream_write(ev)
         return ev
+
+    def emit(self, kind: str, name: str, value, **extra) -> dict:
+        """Record a custom typed event (user-defined ``kind``). The
+        event rides the ring, the JSONL dump, and — when streaming — is
+        flushed to disk immediately (bench sections, health events)."""
+        return self._emit(kind, name, value, **extra)
 
     @property
     def dropped(self) -> int:
@@ -212,6 +308,13 @@ class Recorder:
             with self._lock:
                 self._events.append(ev)
                 self._emitted += 1
+                self._stream_write(ev)
+                observers = list(self._observers)
+            for obs in observers:
+                try:
+                    obs(ev, self)
+                except Exception:
+                    pass   # a watchdog bug must not kill the training loop
 
     # -- views ---------------------------------------------------------------
     def records(self, kind: Optional[str] = None) -> list[dict]:
@@ -253,9 +356,9 @@ class Recorder:
             f = open(path_or_file, "w")
             close = True
         try:
-            f.write(json.dumps(header) + "\n")
+            f.write(json_line(header) + "\n")
             for e in evs:
-                f.write(json.dumps(e) + "\n")
+                f.write(json_line(e) + "\n")
         finally:
             if close:
                 f.close()

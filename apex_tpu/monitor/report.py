@@ -47,8 +47,9 @@ def aggregate(events: Iterable[dict], header: Optional[dict] = None) -> dict:
     Returns: ``steps`` (count + step-time distribution + first/last
     values of the per-step gauges), ``counters`` (final totals),
     ``gauges`` (last values), ``timers`` (count/total/mean per name),
-    ``collectives`` (final per-``op@axis`` count/bytes table) and any
-    recorded pipeline ``schedules``.
+    ``collectives`` (final per-``op@axis`` count/bytes table), any
+    recorded pipeline ``schedules``, and ``health`` (the watchdog's
+    typed ``health_event`` records, in order).
     """
     counters: dict[str, float] = {}
     gauges: dict[str, float] = {}
@@ -56,6 +57,7 @@ def aggregate(events: Iterable[dict], header: Optional[dict] = None) -> dict:
     collectives: dict[str, dict] = {}
     schedules: dict[str, dict] = {}
     steps: list[dict] = []
+    health: list[dict] = []
     for ev in events:
         kind = ev.get("kind")
         name = ev.get("name", "")
@@ -80,6 +82,11 @@ def aggregate(events: Iterable[dict], header: Optional[dict] = None) -> dict:
                 "bubble_fraction": ev.get("bubble_fraction")}
         elif kind == "step":
             steps.append(ev)
+        elif kind == "health_event":
+            health.append({k: ev.get(k) for k in
+                           ("name", "value", "step", "severity",
+                            "diagnosis", "gauge", "rank", "t")
+                           if ev.get(k) is not None})
     out: dict = {}
     if header:
         out["run"] = {k: header.get(k) for k in ("name", "dropped", "meta")
@@ -106,6 +113,8 @@ def aggregate(events: Iterable[dict], header: Optional[dict] = None) -> dict:
     out["collectives"] = {k: collectives[k] for k in sorted(collectives)}
     if schedules:
         out["schedules"] = schedules
+    if health:
+        out["health"] = health
     return out
 
 
@@ -153,6 +162,13 @@ def render_report(events: list[dict], header: Optional[dict] = None,
     parts.append(f"# monitor report: {title}")
     if run.get("dropped"):
         parts.append(f"(ring buffer dropped {run['dropped']} events)")
+    if agg.get("health"):
+        parts.append("\n## health\n")
+        for ev in agg["health"][:max_rows]:
+            loc = f"step {ev['step']}" if ev.get("step") is not None else \
+                (f"rank {ev['rank']}" if ev.get("rank") is not None else "-")
+            parts.append(f"- **{ev.get('name')}** [{ev.get('severity')}] "
+                         f"({loc}): {ev.get('diagnosis')}")
     parts.append("\n## per-step\n")
     parts.append(render_steps(events, max_rows=max_rows))
     if "steps" in agg:
@@ -186,6 +202,50 @@ def render_report(events: list[dict], header: Optional[dict] = None,
         parts.append("| counter | total |\n|---|---|")
         for k, v in agg["counters"].items():
             parts.append(f"| {k} | {_fmt(v)} |")
+    return "\n".join(parts)
+
+
+def render_cross_host(merged: dict, max_rows: int = 50) -> str:
+    """Human-readable render of a ``merge.merge_summaries`` cross-host
+    view: summed collective table, per-rank step-time skew, straggler
+    percentiles for the host timers, and any health events."""
+    parts = [f"# monitor cross-host report: {merged.get('n_ranks')} ranks "
+             f"{merged.get('ranks')}"]
+    if merged.get("health_events"):
+        parts.append("\n## health\n")
+        for ev in merged["health_events"][:max_rows]:
+            parts.append(f"- **{ev.get('name')}** [{ev.get('severity')}] "
+                         f"(rank {ev.get('rank')}): {ev.get('diagnosis')}")
+    st = merged.get("steps")
+    if st:
+        sk = st["skew"]
+        parts.append("\n## step-time skew per rank\n")
+        parts.append("| rank | steps | median ms | x global median |\n"
+                     "|---|---|---|---|")
+        for rank in sorted(st["by_rank"], key=int):
+            d = st["by_rank"][rank]
+            ratio = (sk.get("per_rank_ratio") or {}).get(rank)
+            parts.append(f"| {rank} | {d.get('count')} "
+                         f"| {1e3 * d['median']:.3f} | {ratio} |")
+        parts.append(f"\nslowest rank: {sk.get('slowest_rank')}  "
+                     f"(max/median = {sk.get('max_over_median')})")
+    if merged.get("collectives"):
+        parts.append("\n## collectives (summed across ranks, "
+                     "per traced program)\n")
+        parts.append("| collective | count | bytes |\n|---|---|---|")
+        for k, v in merged["collectives"].items():
+            parts.append(f"| {k} | {v['count']} | {v['bytes']} |")
+    if merged.get("timers"):
+        parts.append("\n## timers (per-rank means, straggler "
+                     "percentiles)\n")
+        parts.append("| timer | median mean_s | max mean_s | max/median "
+                     "| slowest rank |\n|---|---|---|---|---|")
+        for k, v in merged["timers"].items():
+            parts.append(
+                f"| {k} | {_fmt(v.get('mean_s_median'))} "
+                f"| {_fmt(v.get('mean_s_max'))} "
+                f"| {v.get('max_over_median')} "
+                f"| {v.get('slowest_rank')} |")
     return "\n".join(parts)
 
 

@@ -85,6 +85,11 @@ def flat_dist_call(tensors: Sequence[jax.Array], op: Callable, axis_name: str = 
     (``apex/parallel/distributed.py:36-75``). ``op`` is e.g.
     ``lambda t: jax.lax.psum(t, axis_name)``."""
     flat = flatten_tensors(list(tensors))
+    if _mon.traced_enabled():
+        # one fused collective over the flat buffer; op is opaque, so
+        # account it under its own name rather than guessing psum
+        _mon.collective("flat_dist_call", axis_name,
+                        nbytes=_mon.tree_bytes(flat), count=1)
     flat = op(flat)
     return unflatten_tensors(flat, list(tensors))
 
@@ -167,6 +172,12 @@ class Reducer:
 
     def reduce(self, tree):
         world = _axis_size(self.axis_name)
+        if _mon.traced_enabled():
+            floats = [g for g in jax.tree.leaves(tree)
+                      if jnp.issubdtype(g.dtype, jnp.floating)]
+            _mon.collective("psum", self.axis_name,
+                            nbytes=_mon.tree_bytes(floats),
+                            count=len(floats))
         return jax.tree.map(
             lambda g: jax.lax.psum(g, self.axis_name) / world
             if jnp.issubdtype(g.dtype, jnp.floating) else g, tree)

@@ -48,6 +48,14 @@ def init_distributed(coordinator_address: str | None = None,
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id)
+    # rank-tag any attached recorder so its JSONL shard self-identifies
+    # (monitor.merge reads process_index/process_count from the header)
+    from apex_tpu import monitor
+    rec = monitor.get_recorder()
+    if rec is not None:
+        rec.meta.setdefault("process_index", jax.process_index())
+        rec.meta.setdefault("process_count", jax.process_count())
+        rec.gauge("dist/process_index", jax.process_index())
 
 
 def main(argv=None):

@@ -20,6 +20,7 @@ transformer blocks).
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Optional
 
 import jax
@@ -141,6 +142,41 @@ def _probe_h(embed_fn, embed_params, slice_mb):
     return probe.shape, probe.dtype
 
 
+# debug-mode axis-usage probe (the embed_fn/loss_fn collective contract)
+_AXIS_PROBE_ENV = "APEX_TPU_PIPELINE_AXIS_PROBE"
+
+
+def _axis_probe_enabled(flag: Optional[bool]) -> bool:
+    if flag is not None:
+        return bool(flag)
+    return os.environ.get(_AXIS_PROBE_ENV, "0") == "1"
+
+
+def _probe_no_pipeline_collectives(tag: str, fn, args, axis_name: str):
+    """Debug probe behind ``debug_axis_probe=True`` (or env
+    ``APEX_TPU_PIPELINE_AXIS_PROBE=1``): abstractly trace ``fn`` (an
+    eval_shape-cost trace — no compile, no execution) and fail fast if
+    it carries collectives over the *pipeline* axis. The 1F1B tick
+    cores run embed_fn/loss_fn under per-rank ``lax.cond`` branches, so
+    a pipeline-axis collective inside them would be executed by only
+    some pp ranks — a silent deadlock/corruption at runtime; this turns
+    it into an immediate, named error at trace time. Group-local
+    collectives (e.g. a VocabParallelEmbedding's tensor-axis psum) are
+    fine and pass."""
+    from apex_tpu.lint.jaxpr_checks import collective_axis_names
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    used = collective_axis_names(jaxpr.jaxpr)
+    if axis_name in used:
+        raise ValueError(
+            f"{tag} carries a collective over the pipeline axis "
+            f"'{axis_name}' (axes seen: {sorted(used)}). The 1F1B "
+            f"schedules run {tag} under lax.cond on a per-rank "
+            "predicate, so only some pipeline ranks would execute the "
+            "collective — a deadlock/corruption. Keep pipeline-axis "
+            "reductions (loss/grad psum) OUTSIDE the schedule call; "
+            "tensor-axis collectives inside embed/head are fine.")
+
+
 def _head_seed(loss_fn, pred, head_params, out_b, in_b):
     """Loss + head grads + backward seed under ``lax.cond(pred)`` — ONLY
     the seeding rank pays for the head (its collectives are group-local
@@ -232,7 +268,11 @@ def forward_backward_pipelining_1f1b(
 
     ``loss_mb(out) -> scalar`` applies per microbatch on the last stage;
     the returned loss is the SUM over microbatches (divide inside
-    ``loss_mb`` by ``n_microbatches`` for a mean). Returns
+    ``loss_mb`` by ``n_microbatches`` for a mean). ``loss_mb`` runs
+    under a last-rank-only ``lax.cond`` and therefore MUST NOT carry
+    pipeline-axis collectives (tensor-axis ones are fine — see
+    ``forward_backward_pipelining_1f1b_model`` for the full contract
+    and the ``APEX_TPU_PIPELINE_AXIS_PROBE`` debug check). Returns
     ``(loss, grads)`` with the loss masked to the last rank — ``psum``
     both over the pipeline axis, exactly as with the fill-drain variant.
 
@@ -252,8 +292,22 @@ def forward_backward_pipelining_1f1b(
 def forward_backward_pipelining_1f1b_model(
         embed_fn: Callable, stage_fn: Callable, loss_fn: Callable,
         params, inputs, n_microbatches: int,
-        axis_name: str = ps.PIPELINE_AXIS):
+        axis_name: str = ps.PIPELINE_AXIS,
+        debug_axis_probe: Optional[bool] = None):
     """1F1B for a FULL model: embed + stages + loss head, flat memory.
+
+    **Contract — embed_fn/loss_fn must carry no pipeline-axis
+    collectives.** Both run under ``lax.cond`` branches taken by a
+    single pipeline rank (rank 0 for embed, the last rank for the loss
+    head), so a collective over ``axis_name`` inside either would be
+    entered by only part of the pipeline group: a deadlock on real
+    meshes, silent corruption on others. Collectives over *other* axes
+    (e.g. VocabParallelEmbedding's tensor-axis psum) are group-local to
+    one pp row and are fine. Do pipeline-axis reductions (summing the
+    returned loss/grads across ranks) OUTSIDE this call. Set
+    ``debug_axis_probe=True`` (or env ``APEX_TPU_PIPELINE_AXIS_PROBE=1``)
+    to verify the contract at trace time: an eval_shape-cost abstract
+    trace of both functions raises a named error on violation.
 
     ``forward_backward_pipelining_1f1b`` above handles the stage stack
     only; a real model also needs gradients for the embedding (rank 0)
@@ -302,6 +356,15 @@ def forward_backward_pipelining_1f1b_model(
     slice_mb = _mb_slicer(inputs)
 
     h_shape, h_dtype = _probe_h(embed_fn, params["embed"], slice_mb)
+
+    if _axis_probe_enabled(debug_axis_probe):
+        _probe_no_pipeline_collectives(
+            "embed_fn", embed_fn, (params["embed"], slice_mb(0)),
+            axis_name)
+        _probe_no_pipeline_collectives(
+            "loss_fn", loss_fn,
+            (params["head"], jnp.zeros(h_shape, h_dtype), slice_mb(0)),
+            axis_name)
 
     init = (
         jnp.zeros(h_shape, h_dtype),                      # held_f
@@ -373,7 +436,8 @@ def forward_backward_pipelining_1f1b_model(
 def forward_backward_pipelining_1f1b_interleaved_model(
         embed_fn: Callable, stage_fn: Callable, loss_fn: Callable,
         params, inputs, n_microbatches: int, n_chunks: int,
-        axis_name: str = ps.PIPELINE_AXIS):
+        axis_name: str = ps.PIPELINE_AXIS,
+        debug_axis_probe: Optional[bool] = None):
     """Interleaved (vpp) 1F1B: Megatron's production schedule — virtual
     chunks AND flat activation memory — as one SPMD scan.
 
@@ -414,10 +478,14 @@ def forward_backward_pipelining_1f1b_interleaved_model(
     ``n_microbatches`` (asserted by
     ``test_pipeline_interleaved_1f1b_memory_flat``).
 
-    Same contracts as ``forward_backward_pipelining_1f1b_model``:
-    ``params`` = {embed, stage, head} with ``stage`` leaves stacked
-    [n_chunks, ...]; returns ``(loss_sum, grads)`` with embed/head grads
-    on their owning ranks — psum over the pipeline axis. Requires
+    Same contracts as ``forward_backward_pipelining_1f1b_model`` —
+    including **embed_fn/loss_fn must carry no pipeline-axis
+    collectives** (they run under single-rank ``lax.cond`` branches;
+    tensor-axis collectives are fine; ``debug_axis_probe=True`` or env
+    ``APEX_TPU_PIPELINE_AXIS_PROBE=1`` trace-checks this): ``params`` =
+    {embed, stage, head} with ``stage`` leaves stacked [n_chunks, ...];
+    returns ``(loss_sum, grads)`` with embed/head grads on their owning
+    ranks — psum over the pipeline axis. Requires
     ``n_microbatches % P == 0`` (the Megatron interleaving constraint).
     """
     n_microbatches = resolve_num_microbatches(n_microbatches)
@@ -453,6 +521,15 @@ def forward_backward_pipelining_1f1b_interleaved_model(
             tree)
 
     h_shape, h_dtype = _probe_h(embed_fn, params["embed"], slice_mb)
+
+    if _axis_probe_enabled(debug_axis_probe):
+        _probe_no_pipeline_collectives(
+            "embed_fn", embed_fn, (params["embed"], slice_mb(0)),
+            axis_name)
+        _probe_no_pipeline_collectives(
+            "loss_fn", loss_fn,
+            (params["head"], jnp.zeros(h_shape, h_dtype), slice_mb(0)),
+            axis_name)
 
     init = (
         jnp.zeros(h_shape, h_dtype),                          # held_f
@@ -548,7 +625,11 @@ def forward_backward_pipelining_1f1b_interleaved(
     """Headless interleaved 1F1B (stage stack only) — the vpp analog of
     ``forward_backward_pipelining_1f1b``. ``chunk_params`` leaves stacked
     [n_chunks, ...]; ``loss_mb(out) -> scalar`` per microbatch on the
-    last rank's LAST chunk. Returns (loss_sum, chunk grads)."""
+    last rank's LAST chunk, run under a single-rank ``lax.cond`` — so it
+    MUST NOT carry pipeline-axis collectives (the
+    ``forward_backward_pipelining_1f1b_model`` contract; verify with
+    ``APEX_TPU_PIPELINE_AXIS_PROBE=1``). Returns (loss_sum, chunk
+    grads)."""
     if n_chunks is None:
         leaf = jax.tree_util.tree_leaves(chunk_params)[0]
         n_chunks = leaf.shape[0]

@@ -36,12 +36,42 @@ Timing methodology (round-4 rules):
   fused-CE path cannot inflate its own numerator via kernel recompute.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Streaming evidence (r5 postmortem — ``BENCH_r05.json: rc=124, parsed:
+null`` lost a full round of numbers to one overall timeout): every
+section now routes through an ``apex_tpu.monitor`` Recorder with
+incremental flush. As each section completes, its result dict is
+appended to the evidence stream (``bench_stream.jsonl``; one JSON line,
+flushed) *immediately*, and the final printed JSON is assembled FROM
+those flushed lines — so a timeout, crash, or SIGTERM mid-run preserves
+every completed section. Recovery paths:
+
+- ``python bench.py --assemble bench_stream.jsonl`` rebuilds the final
+  JSON from a partial stream (what a driver should do after rc=124).
+- SIGTERM prints the assembled partial JSON (with ``interrupted``) on
+  the way out.
+- Per-section wall-clock budgets (SIGALRM) give skip-and-record
+  semantics: a runaway section is recorded as ``<name>_error: timeout``
+  and the run moves on. ``BENCH_DEADLINE_S`` adds a global soft
+  deadline — sections that would start after it are skipped-and-
+  recorded. NB: Python delivers signals between bytecodes, so one
+  long-blocking XLA compile defers (not defeats) its section timeout.
+
+``--smoke`` runs a tiny-shape CPU section set (plus a deliberately
+timed-out probe section) and asserts every expected section key made it
+into the stream — the CI guard against a repeat of the r5 evidence
+loss. Existing BENCH JSON keys are unchanged on a normal full run.
 """
 
 from __future__ import annotations
 
+import argparse
 import contextlib
 import json
+import os
+import signal
+import sys
+import threading
 import time
 
 BATCH = 256
@@ -1101,106 +1131,99 @@ def _monitor_extras(rec):
     }
 
 
-def main():
-    from apex_tpu import monitor
-    # host-only observer: times and compile events flow into the
-    # recorder while the benchmarked programs stay uninstrumented
-    # (traced_hooks=False — no callbacks, no retrace, no inserted ops)
-    rec = monitor.Recorder(name="bench", capacity=16384,
-                           traced_hooks=False)
-    monitor.trace.install_compile_logging()
-    monitor.attach(rec)
+# ---------------------------------------------------------------------------
+# streaming-evidence framework (module docstring: the r5 fix)
+# ---------------------------------------------------------------------------
+
+# the contract keys the driver parses; assemble() falls back to these
+# when the core section never completed
+_CONTRACT = {"metric": "resnet50_O2_train_throughput", "value": 0.0,
+             "unit": "imgs/sec/chip", "vs_baseline": 0.0}
+
+
+class SectionTimeout(BaseException):
+    # BaseException, NOT Exception: section code is full of broad
+    # `except Exception` guards (_step_flops, _trace_top_ops, the bench
+    # error recording itself) that would otherwise swallow the SIGALRM
+    # raise — and the one-shot itimer never re-fires, silently defeating
+    # the budget exactly where sections actually hang
+    pass
+
+
+@contextlib.contextmanager
+def _alarm(budget_s: float):
+    """Wall-clock budget for one section via SIGALRM; no-op off the
+    main thread / without setitimer (Windows), and when budget_s <= 0."""
+    if (not budget_s or not hasattr(signal, "setitimer")
+            or threading.current_thread() is not threading.main_thread()):
+        yield
+        return
+
+    def _raise(signum, frame):
+        raise SectionTimeout()
+
+    prev = signal.signal(signal.SIGALRM, _raise)
+    signal.setitimer(signal.ITIMER_REAL, budget_s)
     try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, prev)
+
+
+def _run_section(rec, name: str, fn, budget_s: float, deadline=None):
+    """Run one section with skip-and-record semantics. Whatever happens
+    — result, exception, timeout, deadline skip — ONE section event is
+    emitted and (via the recorder's stream) flushed to disk before the
+    next section starts."""
+    t0 = time.monotonic()
+    if deadline is not None and t0 >= deadline:
+        data = {f"{name}_skipped":
+                "deadline: global bench budget exhausted"}
+    else:
+        try:
+            with _alarm(budget_s):
+                data = fn() or {}
+        except SectionTimeout:
+            data = {f"{name}_error":
+                    f"timeout: exceeded {budget_s:.0f}s section budget"}
+        except Exception as e:
+            data = {f"{name}_error": f"{type(e).__name__}: {e}"[:300]}
+    rec.emit("section", name, round(time.monotonic() - t0, 3), data=data)
+    return data
+
+
+def assemble(stream_path: str) -> dict:
+    """Rebuild the final BENCH JSON from the flushed evidence lines —
+    works on a partial stream from a killed run (``--assemble``)."""
+    from apex_tpu.monitor.report import load_jsonl
+    _, events = load_jsonl(stream_path)
+    out: dict = {}
+    names: list[str] = []
+    for ev in events:
+        if ev.get("kind") == "section":
+            out.update(ev.get("data") or {})
+            names.append(ev.get("name"))
+    if "value" not in out:    # core never completed: contract fallback
+        err = out.get("core_error") or \
+            "incomplete run: core section missing from evidence stream"
+        out = {**_CONTRACT, "error": err, **out}
+    out["sections_completed"] = names
+    return out
+
+
+def _sections_full(ctx: dict, rec) -> list:
+    """Ordered (name, budget_s, fn) registry for the full TPU bench.
+    Section result dicts merge (in order) into the final JSON, so the
+    key set of a normal complete run matches the pre-streaming bench."""
+
+    def core():
+        import jax
         o2_ips, o2_dt, o2_flops, o2_iqr, o2_disp = _time_steps(
             "O2", want_flops=True, want_dispatch=True)
         o0_ips, _, _, _, _ = _time_steps("O0")
-        extras = {"timing": {"windows": WINDOWS, "scan_k": SCAN_K,
-                             "o2_step_iqr_ms": round(o2_iqr * 1e3, 3)}}
-        if o2_disp:
-            extras["o2_step_ms_per_dispatch"] = round(o2_disp * 1e3, 2)
-        try:
-            o1_ips, _, _, _, _ = _time_steps("O1")
-            extras["o1_speedup_vs_o0"] = round(o1_ips / o0_ips, 3)
-        except Exception as e:
-            extras["o1_error"] = f"{type(e).__name__}: {e}"[:120]
-        peak = _peak_flops()
-        if o2_flops and peak:
-            extras["mfu"] = round(o2_flops / o2_dt / peak, 4)
-        try:
-            extras["loader"] = _bench_loader()
-        except Exception as e:
-            extras["loader_error"] = f"{type(e).__name__}: {e}"[:120]
-        try:
-            adam_speedup, dt_f, dt_e = _bench_fused_adam()
-            extras["fused_adam_speedup"] = round(adam_speedup, 3)
-            extras["fused_adam_ms"] = round(dt_f * 1e3, 3)
-            extras["eager_adam_ms"] = round(dt_e * 1e3, 3)
-        except Exception as e:
-            extras["fused_adam_error"] = f"{type(e).__name__}: {e}"[:120]
-        try:
-            gpt_tps, gpt_mfu, gpt_ops, gpt_iqr, gpt_disp = _bench_gpt()
-            extras["gpt_tokens_per_sec"] = round(gpt_tps, 1)
-            if gpt_mfu:
-                extras["gpt_mfu"] = round(gpt_mfu, 4)
-            extras["gpt_step_iqr_ms"] = round(gpt_iqr * 1e3, 3)
-            extras["gpt_step_ms_per_dispatch"] = round(gpt_disp * 1e3, 2)
-            if gpt_ops:
-                extras["gpt_top_ops"] = gpt_ops
-        except Exception as e:
-            extras["gpt_error"] = f"{type(e).__name__}: {e}"[:120]
-        try:
-            ls_tps, ls_dt, ls_iqr = _bench_gpt_long_seq()
-            extras["gpt_s4096_tokens_per_sec"] = round(ls_tps, 1)
-            extras["gpt_s4096_step_ms"] = round(ls_dt * 1e3, 2)
-            extras["gpt_s4096_step_iqr_ms"] = round(ls_iqr * 1e3, 3)
-        except Exception as e:
-            extras["gpt_s4096_error"] = f"{type(e).__name__}: {e}"[:120]
-        try:
-            import os as _os
-            if _os.environ.get("BENCH_CONVERGENCE") == "1":
-                extras["convergence"] = _bench_convergence()
-        except Exception as e:
-            extras["convergence_error"] = f"{type(e).__name__}: {e}"[:120]
-        try:
-            bert_tps, bert_mfu, bert_ops, bert_iqr, bert_disp = _bench_bert()
-            extras["bert_tokens_per_sec"] = round(bert_tps, 1)
-            if bert_mfu:
-                extras["bert_mfu"] = round(bert_mfu, 4)
-            extras["bert_step_iqr_ms"] = round(bert_iqr * 1e3, 3)
-            extras["bert_step_ms_per_dispatch"] = round(bert_disp * 1e3, 2)
-            if bert_ops:
-                extras["bert_top_ops"] = bert_ops
-        except Exception as e:
-            extras["bert_error"] = f"{type(e).__name__}: {e}"[:120]
-        try:
-            (moe_tps, moe_dt, moe_iqr), (t1_tps, t1_dt, t1_iqr), \
-                moe_mfu, moe_health = _bench_gpt_moe()
-            extras["gpt_moe_tokens_per_sec"] = round(moe_tps, 1)
-            extras["gpt_moe_step_ms"] = round(moe_dt * 1e3, 2)
-            extras["gpt_moe_step_iqr_ms"] = round(moe_iqr * 1e3, 3)
-            extras["gpt_moe_top1_tokens_per_sec"] = round(t1_tps, 1)
-            extras["gpt_moe_top1_step_ms"] = round(t1_dt * 1e3, 2)
-            if moe_mfu:
-                extras["gpt_moe_mfu"] = round(moe_mfu, 4)
-            extras["gpt_moe_routing"] = moe_health
-        except Exception as e:
-            extras["gpt_moe_error"] = f"{type(e).__name__}: {e}"[:120]
-        # new r5 extras LAST: core metrics survive a driver deadline
-        try:
-            extras["ring_s32k"] = _bench_ring_s32k()
-        except Exception as e:
-            extras["ring_s32k_error"] = f"{type(e).__name__}: {e}"[:120]
-        try:
-            extras["dispatch_overhead"] = _bench_dispatch_overhead()
-        except Exception as e:
-            extras["dispatch_overhead_error"] = \
-                f"{type(e).__name__}: {e}"[:120]
-        try:
-            extras.update(_monitor_extras(rec))
-        except Exception as e:
-            extras["monitor_error"] = f"{type(e).__name__}: {e}"[:120]
-        import jax
-        print(json.dumps({
+        ctx["o0_ips"] = o0_ips
+        out = {
             "metric": "resnet50_O2_train_throughput",
             "value": round(o2_ips, 2),
             "unit": "imgs/sec/chip",
@@ -1208,20 +1231,273 @@ def main():
             "o0_imgs_per_sec": round(o0_ips, 2),
             "o2_step_ms": round(o2_dt * 1e3, 2),
             "device": getattr(jax.devices()[0], "device_kind", "unknown"),
-            **extras,
-        }))
-    except Exception as e:  # still emit the contract line on failure
-        print(json.dumps({
-            "metric": "resnet50_O2_train_throughput",
-            "value": 0.0,
-            "unit": "imgs/sec/chip",
-            "vs_baseline": 0.0,
-            "error": f"{type(e).__name__}: {e}"[:300],
-        }))
-        raise
-    finally:
+            "timing": {"windows": WINDOWS, "scan_k": SCAN_K,
+                       "o2_step_iqr_ms": round(o2_iqr * 1e3, 3)},
+        }
+        if o2_disp:
+            out["o2_step_ms_per_dispatch"] = round(o2_disp * 1e3, 2)
+        peak = _peak_flops()
+        if o2_flops and peak:
+            out["mfu"] = round(o2_flops / o2_dt / peak, 4)
+        return out
+
+    def o1():
+        if "o0_ips" not in ctx:   # core never completed: don't burn
+            return {"o1_skipped": "core section did not complete"}
+        o1_ips, _, _, _, _ = _time_steps("O1")
+        return {"o1_speedup_vs_o0": round(o1_ips / ctx["o0_ips"], 3)}
+
+    def fused_adam():
+        adam_speedup, dt_f, dt_e = _bench_fused_adam()
+        return {"fused_adam_speedup": round(adam_speedup, 3),
+                "fused_adam_ms": round(dt_f * 1e3, 3),
+                "eager_adam_ms": round(dt_e * 1e3, 3)}
+
+    def gpt():
+        gpt_tps, gpt_mfu, gpt_ops, gpt_iqr, gpt_disp = _bench_gpt()
+        out = {"gpt_tokens_per_sec": round(gpt_tps, 1),
+               "gpt_step_iqr_ms": round(gpt_iqr * 1e3, 3),
+               "gpt_step_ms_per_dispatch": round(gpt_disp * 1e3, 2)}
+        if gpt_mfu:
+            out["gpt_mfu"] = round(gpt_mfu, 4)
+        if gpt_ops:
+            out["gpt_top_ops"] = gpt_ops
+        return out
+
+    def gpt_s4096():
+        ls_tps, ls_dt, ls_iqr = _bench_gpt_long_seq()
+        return {"gpt_s4096_tokens_per_sec": round(ls_tps, 1),
+                "gpt_s4096_step_ms": round(ls_dt * 1e3, 2),
+                "gpt_s4096_step_iqr_ms": round(ls_iqr * 1e3, 3)}
+
+    def bert():
+        bert_tps, bert_mfu, bert_ops, bert_iqr, bert_disp = _bench_bert()
+        out = {"bert_tokens_per_sec": round(bert_tps, 1),
+               "bert_step_iqr_ms": round(bert_iqr * 1e3, 3),
+               "bert_step_ms_per_dispatch": round(bert_disp * 1e3, 2)}
+        if bert_mfu:
+            out["bert_mfu"] = round(bert_mfu, 4)
+        if bert_ops:
+            out["bert_top_ops"] = bert_ops
+        return out
+
+    def gpt_moe():
+        (moe_tps, moe_dt, moe_iqr), (t1_tps, t1_dt, t1_iqr), \
+            moe_mfu, moe_health = _bench_gpt_moe()
+        out = {"gpt_moe_tokens_per_sec": round(moe_tps, 1),
+               "gpt_moe_step_ms": round(moe_dt * 1e3, 2),
+               "gpt_moe_step_iqr_ms": round(moe_iqr * 1e3, 3),
+               "gpt_moe_top1_tokens_per_sec": round(t1_tps, 1),
+               "gpt_moe_top1_step_ms": round(t1_dt * 1e3, 2),
+               "gpt_moe_routing": moe_health}
+        if moe_mfu:
+            out["gpt_moe_mfu"] = round(moe_mfu, 4)
+        return out
+
+    sections = [
+        ("core", 2400, core),
+        ("o1", 900, o1),
+        ("loader", 900, lambda: {"loader": _bench_loader()}),
+        ("fused_adam", 600, fused_adam),
+        ("gpt", 1200, gpt),
+        ("gpt_s4096", 1200, gpt_s4096),
+    ]
+    if os.environ.get("BENCH_CONVERGENCE") == "1":
+        sections.append(
+            ("convergence", 3600,
+             lambda: {"convergence": _bench_convergence()}))
+    sections += [
+        ("bert", 1200, bert),
+        ("gpt_moe", 1500, gpt_moe),
+        ("ring_s32k", 2400, lambda: {"ring_s32k": _bench_ring_s32k()}),
+        ("dispatch_overhead", 300,
+         lambda: {"dispatch_overhead": _bench_dispatch_overhead()}),
+        ("monitor", 120, lambda: _monitor_extras(rec)),
+    ]
+    return sections
+
+
+# every section a --smoke run must leave in the stream, even when one is
+# forcibly timed out (the probe) — asserted after the run
+SMOKE_EXPECTED = ("smoke_mlp_amp", "smoke_fused_adam",
+                  "smoke_noop_dispatch", "smoke_timeout_probe", "monitor")
+
+
+def _sections_smoke(ctx: dict, rec) -> list:
+    """Tiny-shape CPU section set for CI: exercises the full streaming
+    pipeline (incremental flush, budgets, timeout recording, assembly)
+    in seconds. ``smoke_timeout_probe`` deliberately sleeps past its
+    budget so the timed-out-section path is proven on every CI run."""
+
+    def mlp_amp():
+        import jax
+        import jax.numpy as jnp
+        from apex_tpu import amp
+        from apex_tpu.amp import scaler as scaler_mod
+        from apex_tpu.optimizers import FusedSGD
+
+        def loss_fn(p, x, y):
+            h = jnp.tanh(x @ p["w1"])
+            return jnp.mean((h @ p["w2"] - y) ** 2)
+
+        params = {"w1": jnp.ones((4, 8), jnp.float32) * 0.1,
+                  "w2": jnp.ones((8, 2), jnp.float32) * 0.1}
+        opt = FusedSGD(lr=0.05)
+        opt_state = opt.init(params)
+        sstate = scaler_mod.init_state(2.0 ** 8)
+        step = amp.make_train_step(loss_fn, opt, donate=False)
+        x = jnp.ones((2, 4), jnp.float32)
+        y = jnp.ones((2, 2), jnp.float32)
+        n = 3
+        t0 = time.perf_counter()
+        for _ in range(n):
+            params, opt_state, sstate, loss = step(
+                params, opt_state, sstate, x, y)
+        loss = float(loss)
+        dt = (time.perf_counter() - t0) / n
+        return {"metric": "bench_smoke", "value": round(1.0 / dt, 2),
+                "unit": "steps/sec", "vs_baseline": 1.0,
+                "device": getattr(jax.devices()[0], "device_kind",
+                                  "unknown"),
+                "smoke_mlp_final_loss": round(loss, 6)}
+
+    def fused_adam():
+        import jax
+        import jax.numpy as jnp
+        from apex_tpu.optimizers import FusedAdam
+        params = {f"p{i}": jnp.ones((16, 16), jnp.float32)
+                  for i in range(4)}
+        grads = {k: jnp.full_like(v, 1e-3) for k, v in params.items()}
+        opt = FusedAdam(lr=1e-3)
+        state = opt.init(params)
+        fused = jax.jit(lambda s, p, g: opt.apply(s, p, g))
+        new_p, _ = fused(state, params, grads)
+        float(new_p["p0"][0, 0])
+        t0 = time.perf_counter()
+        new_p, _ = fused(state, params, grads)
+        float(new_p["p0"][0, 0])
+        return {"smoke_fused_adam_ms":
+                round((time.perf_counter() - t0) * 1e3, 3)}
+
+    def noop():
+        import jax
+        import jax.numpy as jnp
+        f = jax.jit(lambda x: x + 1.0)
+        float(f(jnp.float32(1.0)))
+        t0 = time.perf_counter()
+        float(f(jnp.float32(1.0)))
+        return {"smoke_noop_ms":
+                round((time.perf_counter() - t0) * 1e3, 3)}
+
+    def timeout_probe():
+        # sleeps past its (default 1 s) budget — the simulated runaway
+        # section; BENCH_SMOKE_HANG_S stretches it for the SIGTERM test
+        time.sleep(float(os.environ.get("BENCH_SMOKE_HANG_S", "3")))
+        return {"smoke_timeout_probe_slept": True}
+
+    probe_budget = float(os.environ.get("BENCH_SMOKE_PROBE_BUDGET_S", "1"))
+    return [
+        ("smoke_mlp_amp", 300, mlp_amp),
+        ("smoke_fused_adam", 120, fused_adam),
+        ("smoke_noop_dispatch", 60, noop),
+        ("smoke_timeout_probe", probe_budget, timeout_probe),
+        ("monitor", 60, lambda: _monitor_extras(rec)),
+    ]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="bench.py")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny-shape CPU sections + forced-timeout probe; "
+                        "asserts the stream holds every expected section")
+    p.add_argument("--stream", default=None, metavar="PATH",
+                   help="evidence stream path (default: "
+                        "$BENCH_STREAM_PATH or bench_stream.jsonl)")
+    p.add_argument("--assemble", default=None, metavar="PATH",
+                   help="print the final JSON assembled from an existing "
+                        "(possibly partial) stream, then exit")
+    p.add_argument("--budget-scale", type=float,
+                   default=float(os.environ.get(
+                       "BENCH_SECTION_BUDGET_SCALE", "1.0")),
+                   help="multiply every per-section budget")
+    args = p.parse_args(argv)
+
+    if args.assemble:
+        from apex_tpu.monitor.recorder import json_safe
+        print(json.dumps(json_safe(assemble(args.assemble))))
+        return 0
+
+    stream_path = args.stream or os.environ.get("BENCH_STREAM_PATH") or \
+        ("bench_smoke_stream.jsonl" if args.smoke else "bench_stream.jsonl")
+
+    from apex_tpu import monitor
+    # host-only observer: times and compile events flow into the
+    # recorder while the benchmarked programs stay uninstrumented
+    # (traced_hooks=False — no callbacks, no retrace, no inserted ops);
+    # stream=... flushes every event (and section line) to disk as it
+    # lands, so a killed run leaves complete evidence of what finished
+    rec = monitor.Recorder(name="bench", capacity=16384,
+                           traced_hooks=False, stream=stream_path)
+    monitor.trace.install_compile_logging()
+    monitor.attach(rec)
+
+    ctx: dict = {}
+    done = {"final": None}
+
+    def finalize(interrupted=None):
+        if done["final"] is not None:
+            return done["final"]
         monitor.detach()
+        rec.close()
+        out = assemble(stream_path)
+        if interrupted:
+            out["interrupted"] = interrupted
+        done["final"] = out
+        from apex_tpu.monitor.recorder import json_safe
+        print(json.dumps(json_safe(out)), flush=True)
+        return out
+
+    def _on_term(signum, frame):
+        finalize(interrupted="SIGTERM")
+        os._exit(143)
+
+    prev_term = None
+    if threading.current_thread() is threading.main_thread():
+        prev_term = signal.signal(signal.SIGTERM, _on_term)
+
+    deadline = None
+    if os.environ.get("BENCH_DEADLINE_S"):
+        deadline = time.monotonic() + float(os.environ["BENCH_DEADLINE_S"])
+
+    sections = _sections_smoke(ctx, rec) if args.smoke \
+        else _sections_full(ctx, rec)
+    try:
+        for name, budget, fn in sections:
+            _run_section(rec, name, fn, budget * args.budget_scale,
+                         deadline)
+    finally:
+        if prev_term is not None:
+            signal.signal(signal.SIGTERM, prev_term)
+    out = finalize()
+
+    if args.smoke:
+        # the r5 guard: every expected section key must be in the STREAM
+        # (re-read from disk), including the forcibly timed-out probe
+        from apex_tpu.monitor.report import load_jsonl
+        _, events = load_jsonl(stream_path)
+        seen = {e.get("name") for e in events if e.get("kind") == "section"}
+        missing = [s for s in SMOKE_EXPECTED if s not in seen]
+        probe = out.get("smoke_timeout_probe_error", "")
+        if missing:
+            print(f"bench --smoke: sections missing from stream: "
+                  f"{missing}", file=sys.stderr)
+            return 2
+        if "timeout" not in probe:
+            print("bench --smoke: timeout probe was not recorded as a "
+                  f"section timeout (got: {probe!r})", file=sys.stderr)
+            return 2
+    return 0 if "error" not in out else 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

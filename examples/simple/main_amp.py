@@ -50,8 +50,14 @@ def main():
     loss_scale = args.loss_scale
     if loss_scale not in (None, "dynamic"):
         loss_scale = float(loss_scale)
+    # lr=0.003: the old default (0.01) diverged at EVERY opt level —
+    # momentum 0.9 on a 4-layer *linear* net (activation="none") is
+    # unstable there, grad norms grow without bound and the loss hits
+    # inf/NaN within ~40 steps (root-caused with monitor.Watchdog:
+    # loss_divergence fires by step ~15, then nan — a pure optimization
+    # blow-up, not a precision bug; O0 fp32 diverged identically).
     amp_model, optimizer = amp.initialize(
-        model.apply, FusedSGD(lr=0.01, momentum=0.9),
+        model.apply, FusedSGD(lr=0.003, momentum=0.9),
         opt_level=args.opt_level, loss_scale=loss_scale)
     scaler = optimizer._amp_stash.loss_scalers[0]
 
@@ -94,6 +100,14 @@ def main():
     import contextlib
     from apex_tpu import monitor
     rec = monitor.Recorder(name="simple-amp") if args.monitor else None
+    # the watchdog turns the telemetry into diagnoses: divergence/NaN/
+    # overflow-storm conditions land as health_event records in the
+    # dump and print as they fire (this is what root-caused the old
+    # lr=0.01 default blowing up)
+    dog = monitor.Watchdog(
+        rec, loss_gauges=("train/loss",),
+        on_event=lambda ev: print(
+            f"[watchdog] {ev['name']}: {ev['diagnosis']}")) if rec else None
     with (monitor.attached(rec) if rec else contextlib.nullcontext()):
         for i in range(args.steps):
             x = jnp.asarray(x_all[i])
@@ -101,12 +115,15 @@ def main():
             with (rec.step() if rec else contextlib.nullcontext()):
                 params, opt_state, sstate, loss = sharded_step(
                     params, opt_state, sstate, x, y)
+                if rec is not None:
+                    rec.gauge("train/loss", float(loss))
             if i % 50 == 0 or i == args.steps - 1:
                 print(f"step {i:4d}  loss {float(loss):.6f}  "
                       f"scale {float(sstate.loss_scale):.0f}")
     if rec is not None:
         rec.dump_jsonl(args.monitor)
-        print(f"telemetry: {len(rec.records())} events -> {args.monitor}")
+        print(f"telemetry: {len(rec.records())} events -> {args.monitor} "
+              f"({len(dog.events)} health events)")
     assert float(loss) < 1e-2, f"did not converge: {float(loss)}"
     print("converged ok")
 

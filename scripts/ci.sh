@@ -17,8 +17,16 @@
 #                    and asserts the JSONL dump -> report round trip
 #                    (per-step loss-scale/grad-norm/step-time fields,
 #                    disabled-mode jaxpr purity)
+#   4. bench smoke — python bench.py --smoke: tiny-shape CPU sections
+#                    through the streaming-evidence pipeline, with one
+#                    section FORCIBLY timed out; bench exits non-zero
+#                    unless every expected section key (including the
+#                    timed-out one) landed in the flushed JSONL — the
+#                    guard against a repeat of the r5 evidence loss
+#                    (BENCH_r05.json: rc=124, parsed: null)
 set -uo pipefail
 cd "$(dirname "$0")/.."
+REPO_DIR="$(pwd)"
 
 fail=0
 
@@ -35,6 +43,11 @@ fi
 
 echo "== ci: monitor selfcheck =="
 JAX_PLATFORMS=cpu python -m apex_tpu.monitor selfcheck --quiet || fail=1
+
+echo "== ci: bench streaming-evidence smoke =="
+( cd /tmp && JAX_PLATFORMS=cpu PYTHONPATH="$REPO_DIR" \
+    BENCH_STREAM_PATH=/tmp/ci_bench_smoke_stream.jsonl \
+    python "$REPO_DIR/bench.py" --smoke > /tmp/ci_bench_smoke.json ) || fail=1
 
 if [[ "$fail" == "0" ]]; then
   echo "ci: all gates green"

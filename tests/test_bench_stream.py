@@ -1,0 +1,159 @@
+"""bench.py streaming evidence: the r5 evidence-loss fix.
+
+Acceptance (ISSUE 3): killing bench.py mid-run — per-section timeout or
+SIGTERM — leaves a parseable evidence file containing every completed
+section, and ``--smoke`` asserts the stream holds every expected
+section key even with a forcibly timed-out section.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _smoke_env(stream_path, **extra):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["BENCH_STREAM_PATH"] = stream_path
+    env.update(extra)
+    return env
+
+
+def test_bench_smoke_stream_has_all_sections(tmp_path):
+    """--smoke: every expected section key lands in the flushed stream
+    — including the probe section that is forcibly timed out — and the
+    printed JSON carries the contract keys assembled from the stream."""
+    stream = str(tmp_path / "stream.jsonl")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+        env=_smoke_env(stream, BENCH_SMOKE_HANG_S="2",
+                       BENCH_SMOKE_PROBE_BUDGET_S="1"),
+        capture_output=True, text=True, timeout=280, cwd=str(tmp_path))
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = json.loads(proc.stdout)
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in out, out
+    # the timed-out probe was recorded, not lost
+    assert "timeout" in out["smoke_timeout_probe_error"], out
+    # stream on disk holds one section line per expected section
+    import bench
+    with open(stream) as f:
+        events = [json.loads(ln) for ln in f.read().splitlines()]
+    sections = [e["name"] for e in events if e["kind"] == "section"]
+    assert sections == list(bench.SMOKE_EXPECTED), sections
+    # monitor telemetry (compile timers) streamed alongside
+    assert any(e["kind"] == "timer" for e in events)
+
+
+def test_bench_sigterm_preserves_completed_sections(tmp_path):
+    """SIGTERM mid-run: the evidence file stays parseable with every
+    completed section, stdout still carries an assembled contract JSON,
+    and --assemble rebuilds the same JSON from the partial stream."""
+    stream = str(tmp_path / "stream.jsonl")
+    # the probe hangs (large budget, long sleep) so we can kill mid-run
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--smoke"],
+        env=_smoke_env(stream, BENCH_SMOKE_HANG_S="300",
+                       BENCH_SMOKE_PROBE_BUDGET_S="600"),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=str(tmp_path))
+    try:
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            try:
+                with open(stream) as f:
+                    txt = f.read()
+                if '"smoke_noop_dispatch"' in txt:
+                    break
+            except FileNotFoundError:
+                pass
+            time.sleep(0.5)
+        else:
+            pytest.fail("bench never reached the hang section")
+        proc.send_signal(signal.SIGTERM)
+        stdout, stderr = proc.communicate(timeout=60)
+    finally:
+        proc.kill()
+    assert proc.returncode == 143, (proc.returncode, stderr[-2000:])
+    out = json.loads(stdout)
+    assert out["interrupted"] == "SIGTERM"
+    assert "smoke_mlp_final_loss" in out           # completed sections
+    assert "smoke_noop_ms" in out
+    completed = out["sections_completed"]
+    assert "smoke_timeout_probe" not in completed  # was mid-flight
+    # the file itself: every line valid JSON, sections all there
+    with open(stream) as f:
+        events = [json.loads(ln) for ln in f.read().splitlines()]
+    names = [e["name"] for e in events if e["kind"] == "section"]
+    assert names == completed
+    # --assemble rebuilds the evidence from the partial stream
+    proc2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--assemble", stream],
+        env=_smoke_env(stream), capture_output=True, text=True,
+        timeout=120)
+    assert proc2.returncode == 0, proc2.stderr[-2000:]
+    re_out = json.loads(proc2.stdout)
+    assert re_out["sections_completed"] == completed
+    assert re_out["smoke_noop_ms"] == out["smoke_noop_ms"]
+
+
+def test_assemble_contract_fallback_without_core(tmp_path):
+    """A stream whose core section never completed still assembles to
+    the driver contract (metric/value/unit/vs_baseline + error)."""
+    import bench
+    p = str(tmp_path / "partial.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps({"kind": "header", "name": "bench"}) + "\n")
+        f.write(json.dumps({
+            "kind": "section", "name": "core", "value": 12.0,
+            "data": {"core_error": "timeout: exceeded 2400s section "
+                                   "budget"}}) + "\n")
+        f.write(json.dumps({
+            "kind": "section", "name": "dispatch_overhead", "value": 1.0,
+            "data": {"dispatch_overhead": {"noop_roundtrip_ms": 100.0}}},
+        ) + "\n")
+    out = bench.assemble(p)
+    assert out["metric"] == "resnet50_O2_train_throughput"
+    assert out["value"] == 0.0 and out["vs_baseline"] == 0.0
+    assert "timeout" in out["error"]
+    # the completed non-core section survived the core loss
+    assert out["dispatch_overhead"]["noop_roundtrip_ms"] == 100.0
+    assert out["sections_completed"] == ["core", "dispatch_overhead"]
+
+
+def test_section_runner_skip_and_record(tmp_path):
+    """_run_section semantics in-process: result, exception, timeout,
+    and deadline-skip each leave exactly one flushed section line."""
+    import bench
+    from apex_tpu import monitor
+    p = str(tmp_path / "s.jsonl")
+    rec = monitor.Recorder(name="t", stream=p)
+
+    def boom():
+        raise RuntimeError("kaput")
+
+    def slow():
+        time.sleep(5)
+        return {"never": True}
+
+    assert bench._run_section(rec, "ok", lambda: {"k": 1}, 30) == {"k": 1}
+    assert "kaput" in bench._run_section(rec, "bad", boom, 30)["bad_error"]
+    data = bench._run_section(rec, "hang", slow, 0.2)
+    assert "timeout" in data["hang_error"]
+    data = bench._run_section(rec, "late", lambda: {"k": 2}, 30,
+                              deadline=time.monotonic() - 1)
+    assert "deadline" in data["late_skipped"]
+    rec.close()
+    with open(p) as f:
+        events = [json.loads(ln) for ln in f.read().splitlines()]
+    names = [e["name"] for e in events if e["kind"] == "section"]
+    assert names == ["ok", "bad", "hang", "late"]

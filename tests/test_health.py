@@ -1,0 +1,256 @@
+"""apex_tpu.monitor.health: the training-health watchdog.
+
+Acceptance (ISSUE 3): the watchdog detects a seeded NaN, an overflow
+storm, and a simulated straggler rank — each producing a typed
+``health_event`` that appears in ``monitor report`` — while detached
+mode stays free (the PR 2 purity harness still passes; a host-only
+watchdogged recorder inserts nothing into traced programs).
+"""
+
+import io
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu import monitor
+
+
+@pytest.fixture(autouse=True)
+def _detached():
+    while monitor.get_recorder() is not None:
+        monitor.detach()
+    yield
+    while monitor.get_recorder() is not None:
+        monitor.detach()
+
+
+def _report(rec):
+    buf = io.StringIO()
+    rec.dump_jsonl(buf)
+    buf.seek(0)
+    header, events = monitor.load_jsonl(buf)
+    return monitor.render_report(events, header=header), events
+
+
+# ---------------------------------------------------------------------------
+# seeded NaN through the real amp path (the main_amp.py root-cause story)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_detects_seeded_nan_in_real_run():
+    """A divergent lr (the pre-fix examples/simple/main_amp.py failure
+    mode, scaled down) blows the loss/grad norms to NaN within a few
+    steps; the watchdog names it with a typed health_event and the
+    report renders the diagnosis."""
+    from apex_tpu import amp
+    from apex_tpu.optimizers import FusedSGD
+
+    def loss_fn(p, x, y):
+        h = x @ p["w1"]            # linear net: diverges like the example
+        return jnp.mean((h @ p["w2"] - y) ** 2)
+
+    params = {"w1": jnp.ones((4, 16), jnp.float32),
+              "w2": jnp.ones((16, 2), jnp.float32)}
+    opt = FusedSGD(lr=0.6, momentum=0.9)     # deliberately divergent
+    # (loss grows ~1e3 -> 1e11 -> 1e36 -> inf: divergence is visible
+    # for two finite steps before the blow-up, like the example's)
+    from apex_tpu.amp import scaler as scaler_mod
+    opt_state = opt.init(params)
+    sstate = scaler_mod.init_state(1.0)
+    step = amp.make_train_step(loss_fn, opt, donate=False)
+    x = jnp.ones((8, 4), jnp.float32)
+    y = jnp.zeros((8, 2), jnp.float32)
+
+    fired = []
+    rec = monitor.Recorder(name="nan-run")
+    dog = monitor.Watchdog(rec, on_event=fired.append,
+                           loss_gauges=("train/loss",),
+                           divergence_grace=1, divergence_factor=2.0,
+                           divergence_patience=1)
+    with monitor.attached(rec):
+        for _ in range(12):
+            with rec.step():
+                params, opt_state, sstate, loss = step(
+                    params, opt_state, sstate, x, y)
+                rec.gauge("train/loss", float(loss))
+    names = {e["name"] for e in dog.events}
+    assert "nan" in names, names
+    nan_ev = next(e for e in dog.events if e["name"] == "nan")
+    assert nan_ev["kind"] == "health_event"
+    assert nan_ev["severity"] == "error"
+    assert "divergence" in nan_ev["diagnosis"]
+    # divergence warned before the NaN landed (the watchdog's value:
+    # diagnosis before the loss is unrecoverable)
+    assert "loss_divergence" in names, names
+    rendered, events = _report(rec)
+    assert "## health" in rendered and "**nan**" in rendered
+    assert any(e["kind"] == "health_event" for e in events)
+    assert fired and fired[0]["kind"] == "health_event"
+    # the dump of a NaN run must be STRICT JSON: no bare NaN/Infinity
+    # tokens (json.dumps default output breaks jq/JSON.parse-style
+    # drivers — the exact consumers of crash evidence)
+    buf = io.StringIO()
+    rec.dump_jsonl(buf)
+    buf.seek(0)
+    for ln in buf.read().splitlines():
+        json.loads(ln, parse_constant=lambda c: pytest.fail(
+            f"non-strict JSON constant {c} in dump: {ln[:120]}"))
+
+
+# ---------------------------------------------------------------------------
+# overflow storm through the real scaler
+# ---------------------------------------------------------------------------
+
+def test_watchdog_detects_overflow_storm():
+    """found_inf=True on every step: the dynamic scale halves each
+    update; >= overflow_trips halvings in the window is a storm."""
+    from apex_tpu.amp import scaler as scaler_mod
+
+    rec = monitor.Recorder()
+    dog = monitor.Watchdog(rec, overflow_window=10, overflow_trips=3)
+    sstate = scaler_mod.init_state(2.0 ** 16)
+    with monitor.attached(rec):
+        for _ in range(6):
+            with rec.step():
+                sstate = scaler_mod.update(
+                    sstate, jnp.asarray(True), dynamic=True)
+    storms = [e for e in dog.events if e["name"] == "overflow_storm"]
+    assert len(storms) == 1, dog.events      # fires once per episode
+    assert storms[0]["severity"] == "error"
+    assert "non-finite" in storms[0]["diagnosis"]
+    rendered, _ = _report(rec)
+    assert "**overflow_storm**" in rendered
+    assert float(sstate.loss_scale) < 2.0 ** 16   # scale really fell
+
+
+# ---------------------------------------------------------------------------
+# synthetic-stream detections (plateau / starvation)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_plateau_and_starvation():
+    import time as _time
+    rec = monitor.Recorder()
+    dog = monitor.Watchdog(rec, loss_gauges=("train/loss",),
+                           plateau_window=6, plateau_rtol=1e-3,
+                           starvation_fraction=0.5, starvation_window=3)
+    with monitor.attached(rec):
+        for i in range(8):
+            with rec.step():
+                rec.gauge("train/loss", 1.0)          # perfectly flat
+                # host_wait dominating the step: starvation
+                rec.timer_event("data/host_wait", 0.02)
+                _time.sleep(0.001)
+    names = [e["name"] for e in dog.events]
+    assert "loss_plateau" in names, names
+    assert "loader_starvation" in names, names
+    starve = next(e for e in dog.events
+                  if e["name"] == "loader_starvation")
+    assert "input pipeline" in starve["diagnosis"]
+
+
+def test_watchdog_quiet_on_healthy_run():
+    rec = monitor.Recorder()
+    dog = monitor.Watchdog(rec, loss_gauges=("train/loss",),
+                           plateau_window=4)
+    with monitor.attached(rec):
+        for i in range(8):
+            with rec.step():
+                rec.gauge("train/loss", 1.0 / (i + 1.0))   # falling
+                rec.gauge("amp/loss_scale", 256.0)         # stable
+                rec.gauge("amp/overflow", 0.0)
+    assert dog.events == [], dog.events
+
+
+# ---------------------------------------------------------------------------
+# simulated straggler rank over the cross-host merge
+# ---------------------------------------------------------------------------
+
+def _two_rank_shards(tmp_path, slow_rank=1):
+    import time as _time
+    from apex_tpu.monitor import merge as mg
+    d = str(tmp_path / "shards")
+    for rank in (0, 1):
+        rec = monitor.Recorder(name=f"rank{rank}")
+        with monitor.attached(rec):
+            for _ in range(6):
+                with rec.step():
+                    _time.sleep(0.012 if rank == slow_rank else 0.001)
+        mg.dump_shard(rec, d, process_index=rank, process_count=2)
+        monitor.detach()
+    return d
+
+
+def test_watchdog_flags_simulated_straggler(tmp_path):
+    from apex_tpu.monitor import merge as mg
+    d = _two_rank_shards(tmp_path, slow_rank=1)
+    merged = mg.merge_shards(d)
+    assert merged["steps"]["skew"]["slowest_rank"] == 1
+    sink = monitor.Recorder(name="ops")
+    dog = monitor.Watchdog(sink, straggler_ratio=1.5)
+    events = dog.check_cross_host(merged)
+    stragglers = [e for e in events if e["name"] == "straggler"]
+    assert len(stragglers) == 1 and stragglers[0]["rank"] == 1
+    assert stragglers[0]["kind"] == "health_event"
+    assert "straggler" in stragglers[0]["diagnosis"]
+    # the event landed in the sink recorder and renders in the report
+    rendered, _ = _report(sink)
+    assert "**straggler**" in rendered
+    # and in the cross-host renderer when merged again with the events
+    assert "straggler" in monitor.render_cross_host(
+        {**merged, "health_events":
+         [{**stragglers[0], "rank": 1}]})
+
+
+# ---------------------------------------------------------------------------
+# purity: the watchdog adds no traced ops; detached mode stays free
+# ---------------------------------------------------------------------------
+
+def test_watchdog_host_only_recorder_keeps_program_clean():
+    """A watchdogged host-only recorder must not perturb traced
+    programs, and detaching restores the uninstrumented jaxpr — the
+    PR 2 purity harness, now with the health layer in the loop."""
+    from apex_tpu.amp import scaler as scaler_mod
+
+    sstate = scaler_mod.init_state(128.0)
+
+    def traced():
+        return str(jax.make_jaxpr(
+            lambda s: scaler_mod.update(s, jnp.asarray(False),
+                                        dynamic=True))(sstate))
+
+    baseline = traced()
+    assert "callback" not in baseline
+    rec = monitor.Recorder(traced_hooks=False)
+    monitor.Watchdog(rec)
+    with monitor.attached(rec):
+        assert traced() == baseline
+    assert traced() == baseline
+
+
+def test_observer_exceptions_are_contained():
+    rec = monitor.Recorder()
+
+    def bad_observer(step_ev, r):
+        raise RuntimeError("observer bug")
+
+    rec.add_observer(bad_observer)
+    with rec.step():
+        rec.gauge("g", 1.0)
+    assert len(rec.steps()) == 1   # the step still closed cleanly
+
+
+def test_diagnostics_bundle():
+    from apex_tpu.amp.scaler import LossScaler
+    sc = LossScaler("dynamic", init_scale=256.0)
+    rec = monitor.Recorder()
+    dog = monitor.Watchdog(rec, scaler=sc, diagnostics_steps=2)
+    with monitor.attached(rec):
+        for i in range(4):
+            with rec.step():
+                rec.gauge("train/loss", float("nan") if i == 3 else 1.0)
+    bundle = dog.diagnostics_bundle()
+    assert len(bundle["last_steps"]) == 2
+    assert bundle["scaler"]["scale"] == 256.0
+    assert [e["name"] for e in bundle["health_events"]] == ["nan"]
+    assert isinstance(bundle["device_memory"], list)

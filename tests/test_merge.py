@@ -1,0 +1,154 @@
+"""apex_tpu.monitor.merge: shards, cross-host merge, streaming recorder.
+
+Fast, synthetic-shard coverage of the multi-rank pipeline (the real
+2-process run is exercised by tests/test_multihost.py): rank-tagged
+shard dump/discovery, collective-byte summing across ranks, per-rank
+timer attribution and step-time skew, the CLI ``merge`` subcommand, the
+in-mesh gather's detached-mode guarantee, and the recorder's
+incremental-flush stream.
+"""
+
+import json
+import time
+
+import pytest
+
+from apex_tpu import monitor
+from apex_tpu.monitor import merge as mg
+
+
+@pytest.fixture(autouse=True)
+def _detached():
+    while monitor.get_recorder() is not None:
+        monitor.detach()
+    yield
+    while monitor.get_recorder() is not None:
+        monitor.detach()
+
+
+def _make_shards(tmp_path):
+    d = str(tmp_path / "shards")
+    for rank, (sleep_s, think_s) in enumerate(((0.001, 0.001),
+                                               (0.008, 0.02))):
+        rec = monitor.Recorder(name=f"rank{rank}")
+        with monitor.attached(rec):
+            for i in range(4):
+                with rec.step():
+                    rec.collective("psum", "data", nbytes=1024, count=3)
+                    rec.counter("data/batches")
+                    with rec.timer("worker/think"):
+                        time.sleep(think_s)
+                    time.sleep(sleep_s)
+        mg.dump_shard(rec, d, process_index=rank, process_count=2)
+        monitor.detach()
+    return d
+
+
+def test_dump_shard_tags_and_find_shards(tmp_path):
+    d = _make_shards(tmp_path)
+    shards = mg.find_shards(d)
+    assert [p.split("/")[-1] for p in shards] == [
+        "monitor-0.jsonl", "monitor-1.jsonl"]
+    header, events = monitor.load_jsonl(shards[1])
+    assert header["meta"]["process_index"] == 1
+    assert header["meta"]["process_count"] == 2
+    assert events     # a shard is a normal recorder dump
+
+
+def test_merge_sums_collectives_and_counters(tmp_path):
+    merged = mg.merge_shards(_make_shards(tmp_path))
+    assert merged["n_ranks"] == 2 and merged["ranks"] == [0, 1]
+    # each rank recorded 4 steps x (count=3, 1024 B per call)
+    assert merged["collectives"]["psum@data"] == {
+        "count": 24, "bytes": 8 * 1024}
+    assert merged["collectives_by_rank"]["0"]["psum@data"]["count"] == 12
+    assert merged["counters"]["data/batches"] == 8
+
+
+def test_merge_per_rank_timer_attribution_and_step_skew(tmp_path):
+    merged = mg.merge_shards(_make_shards(tmp_path))
+    think = merged["timers"]["worker/think"]
+    assert set(think["by_rank"]) == {"0", "1"}
+    assert think["slowest_rank"] == 1
+    assert think["mean_s_max"] >= think["mean_s_median"]
+    assert think["by_rank"]["1"]["n"] == 4
+    skew = merged["steps"]["skew"]
+    assert skew["slowest_rank"] == 1
+    assert skew["per_rank_ratio"]["1"] > 1.0 > skew["per_rank_ratio"]["0"]
+    assert merged["steps"]["by_rank"]["0"]["count"] == 4
+    # gauges stay rank-scoped
+    assert set(merged["gauges_by_rank"]) == {"0", "1"}
+
+
+def test_merge_single_shard_and_missing(tmp_path):
+    d = _make_shards(tmp_path)
+    one = mg.merge_shards([mg.shard_path(d, 0)])
+    assert one["n_ranks"] == 1 and one["ranks"] == [0]
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(ValueError):
+        mg.merge_shards(str(empty))
+
+
+def test_cli_merge_report_and_json(tmp_path, capsys):
+    d = _make_shards(tmp_path)
+    from apex_tpu.monitor.__main__ import main as cli_main
+    out_json = str(tmp_path / "merged.json")
+    assert cli_main(["merge", d, "--json", "-o", out_json]) == 0
+    merged = json.loads(capsys.readouterr().out)
+    assert merged["collectives"]["psum@data"]["bytes"] == 8 * 1024
+    with open(out_json) as f:
+        assert json.load(f) == merged
+    # rendered cross-host report via explicit shard paths
+    assert cli_main(["merge", mg.shard_path(d, 0),
+                     mg.shard_path(d, 1)]) == 0
+    rendered = capsys.readouterr().out
+    assert "cross-host report: 2 ranks" in rendered
+    assert "psum@data" in rendered and "step-time skew" in rendered
+
+
+def test_allgather_summaries_detached_is_free_and_single_process():
+    # detached: no recorder -> None, no jax work at all
+    assert mg.allgather_summaries() is None
+    # explicit recorder, single process: degenerates to a local merge
+    rec = monitor.Recorder(name="solo")
+    with monitor.attached(rec):
+        with rec.step():
+            rec.collective("psum", "data", nbytes=64, count=1)
+    merged = mg.allgather_summaries(rec)
+    assert merged["n_ranks"] == 1
+    assert merged["collectives"]["psum@data"]["bytes"] == 64
+
+
+# ---------------------------------------------------------------------------
+# streaming recorder (the crash-resilient evidence substrate)
+# ---------------------------------------------------------------------------
+
+def test_recorder_stream_flushes_incrementally(tmp_path):
+    p = str(tmp_path / "run.jsonl")
+    rec = monitor.Recorder(name="stream", stream=p)
+    # header is on disk before any event
+    with open(p) as f:
+        header = json.loads(f.readline())
+    assert header["kind"] == "header" and header["name"] == "stream"
+    rec.counter("a")
+    with rec.step():
+        rec.gauge("g", 1.0)
+    # every line is flushed the moment it was emitted — read mid-run,
+    # recorder still open (the killed-process guarantee)
+    with open(p) as f:
+        lines = [json.loads(ln) for ln in f.read().splitlines()]
+    kinds = [ev["kind"] for ev in lines]
+    assert kinds[0] == "header"
+    assert "counter" in kinds and "gauge" in kinds and "step" in kinds
+    rec.emit("section", "demo", 1, data={"k": "v"})
+    with open(p) as f:
+        last = json.loads(f.read().splitlines()[-1])
+    assert last["kind"] == "section"
+    assert last["data"] == {"k": "v"}
+    rec.close()
+    # the stream file parses as a normal report input
+    header2, events = monitor.load_jsonl(p)
+    assert header2["name"] == "stream"
+    agg = monitor.aggregate(events, header=header2)
+    assert agg["steps"]["count"] == 1

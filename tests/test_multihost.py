@@ -17,13 +17,21 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.slow
-def test_two_process_train_step():
+def test_two_process_train_step(tmp_path):
+    """The 2-process run now also exercises the telemetry shard
+    pipeline: each rank records with a monitor.Recorder, runs the
+    in-mesh ``allgather_summaries`` merge (MERGE_OK), and dumps a
+    rank-tagged ``monitor-<rank>.jsonl`` shard that ``python -m
+    apex_tpu.monitor merge`` combines — collective bytes summed across
+    ranks, per-rank timer attribution, per-rank step-time skew."""
+    shard_dir = str(tmp_path / "shards")
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["APEX_TPU_COORD_PORT"] = "23457"
+    env["APEX_TPU_MONITOR_SHARD_DIR"] = shard_dir
     proc = subprocess.run(
         [sys.executable, "-m", "apex_tpu.parallel.multiproc",
          "--world-size", "2",
@@ -33,6 +41,56 @@ def test_two_process_train_step():
     assert proc.returncode == 0, out[-3000:]
     assert "MULTIHOST_OK rank=0" in out, out[-3000:]
     assert "MULTIHOST_OK rank=1" in out, out[-3000:]
+    # in-mesh merge runs where the backend can execute cross-process
+    # programs; on jax CPU builds that cannot (worker docstring,
+    # "Multiprocess computations aren't implemented"), the worker
+    # degrades and the offline shard merge below is the coverage
+    for r in (0, 1):
+        assert (f"MERGE_OK rank={r} n_ranks=2" in out
+                or f"MERGE_INMESH_SKIPPED rank={r}" in out), out[-3000:]
+
+    # offline merge of the rank-tagged shards (library + CLI paths)
+    from apex_tpu.monitor import merge as monitor_merge
+    shards = monitor_merge.find_shards(shard_dir)
+    assert [os.path.basename(s) for s in shards] == [
+        "monitor-0.jsonl", "monitor-1.jsonl"]
+    merged = monitor_merge.merge_shards(shard_dir)
+    assert merged["n_ranks"] == 2 and merged["ranks"] == [0, 1]
+    # collective-byte totals: cross-host sum == sum of the per-rank
+    # tables, and each rank accounted the same traced program
+    psum = merged["collectives"]["psum@data"]
+    r0 = merged["collectives_by_rank"]["0"]["psum@data"]
+    r1 = merged["collectives_by_rank"]["1"]["psum@data"]
+    assert psum["bytes"] == r0["bytes"] + r1["bytes"] > 0
+    assert psum["count"] == r0["count"] + r1["count"] >= 2
+    assert r0 == r1, (r0, r1)   # SPMD: identical traced programs
+    # per-rank timer attribution: rank 1 is the seeded straggler
+    think = merged["timers"]["worker/think"]
+    assert set(think["by_rank"]) == {"0", "1"}
+    assert think["slowest_rank"] == 1
+    assert think["by_rank"]["1"]["mean_s"] > think["by_rank"]["0"]["mean_s"]
+    # per-rank step-time skew is present and names a slowest rank
+    skew = merged["steps"]["skew"]
+    assert set(skew["per_rank_ratio"]) == {"0", "1"}
+    assert skew["slowest_rank"] in (0, 1)
+
+    # the CLI path produces the same cross-host view
+    import json
+    proc = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.monitor", "merge", shard_dir,
+         "--json"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "PYTHONPATH": REPO})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    cli = json.loads(proc.stdout)
+    assert cli["collectives"]["psum@data"] == psum
+    # straggler watchdog over the merged view flags the seeded rank
+    # (worker/think rides the step wall time, so rank 1's steps are
+    # measurably slower)
+    from apex_tpu import monitor as m
+    events = m.Watchdog(straggler_ratio=1.2).check_cross_host(merged)
+    assert any(e["name"] == "straggler" for e in events), (
+        events, skew)
 
 
 def test_loader_shards_are_disjoint_and_cover():

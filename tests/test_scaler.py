@@ -53,6 +53,46 @@ def test_unscale_detects_inf_and_divides():
     assert bool(found)
 
 
+def test_unscale_mixed_dtype_tree_barriers_only_fp16_leaves():
+    """Mixed fp16/bf16/fp32 grad tree (master-weight setups): the fp16
+    anti-fusion optimization_barrier is applied PER LEAF — fp16 leaves
+    only. bf16/fp32 leaves have no fp16 rounding ambiguity and must not
+    have their fusion blocked; an fp16-free tree gets no barrier at all.
+    """
+    import jax
+    st = S.init_state(4.0)
+    mixed = {"f16": jnp.asarray([4.0, 8.0], jnp.float16),
+             "bf16": jnp.asarray([2.0], jnp.bfloat16),
+             "f32": jnp.asarray([8.0], jnp.float32)}
+
+    def barrier_opnds(grads):
+        jaxpr = jax.make_jaxpr(lambda g: S.unscale(g, st))(grads)
+        from apex_tpu.lint.jaxpr_checks import iter_eqns
+        return [tuple(iv.aval.dtype for iv in eqn.invars)
+                for eqn in iter_eqns(jaxpr.jaxpr)
+                if eqn.primitive.name == "optimization_barrier"]
+
+    opnds = barrier_opnds(mixed)
+    assert len(opnds) == 1, opnds              # one barrier, one leaf
+    assert all(d == jnp.float16 for d in opnds[0]), opnds
+    # fp16-free trees: no barrier inserted anywhere
+    assert barrier_opnds({"bf16": mixed["bf16"],
+                          "f32": mixed["f32"]}) == []
+
+    # numerics: every dtype unscales, inf in ANY leaf is detected
+    out, found = S.unscale(mixed, st)
+    assert not bool(found)
+    np.testing.assert_allclose(np.asarray(out["f16"]), [1.0, 2.0])
+    np.testing.assert_allclose(np.asarray(out["bf16"]), [0.5])
+    np.testing.assert_allclose(np.asarray(out["f32"]), [2.0])
+    assert all(v.dtype == jnp.float32 for v in out.values())
+    for leaf in ("f16", "bf16", "f32"):
+        bad = dict(mixed)
+        bad[leaf] = jnp.asarray([jnp.inf], mixed[leaf].dtype)
+        _, found = S.unscale(bad, st)
+        assert bool(found), leaf
+
+
 def test_scale_loss_value():
     st = S.init_state(8.0)
     assert float(S.scale_value(jnp.asarray(2.0, jnp.bfloat16), st)) == 16.0
