@@ -39,9 +39,14 @@ def fmha_varlen(qkv, cu_seqlens, *, causal: bool = False,
     q = qkv[:, 0].transpose(1, 0, 2)[None]   # [1, h, total, d]
     k = qkv[:, 1].transpose(1, 0, 2)[None]
     v = qkv[:, 2].transpose(1, 0, 2)[None]
+    blk = min(block, total)
+    # backward blocks stated explicitly: inheritance is intended here
+    # (blocks must stay <= total), and saying so keeps flash_attention's
+    # inherited-backward-blocks warning — and its once-per-process key —
+    # for end users who actually left the backward tiling implicit
     out = flash_attention(q, k, v, segment_ids_q=sids, causal=causal,
-                          scale=scale, block_q=min(block, total),
-                          block_k=min(block, total),
+                          scale=scale, block_q=blk, block_k=blk,
+                          block_q_bwd=blk, block_k_bwd=blk,
                           dropout_rate=dropout_rate,
                           dropout_seed=dropout_seed)
     return out[0].transpose(1, 0, 2)          # [total, h, d]

@@ -2,9 +2,10 @@
 
 Two layers (``docs/lint.md`` has the full catalog):
 
-- AST rules APX001-APX006 over the source tree (import-time jax work,
+- AST rules APX001-APX007 over the source tree (import-time jax work,
   unknown collective axis names, PRNG key reuse, fp32 pins in
-  bf16-castable ops, side effects under jit, array default args);
+  bf16-castable ops, side effects under jit, array default args,
+  undonated jitted train steps);
 - jaxpr checks over traced programs (structural memory/dtype predicates
   plus collective-axis consistency for registered entrypoints).
 

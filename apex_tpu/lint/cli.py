@@ -19,7 +19,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m apex_tpu.lint",
         description="Static analysis for TPU/JAX correctness invariants "
-                    "(AST rules APX001-APX006 + traced jaxpr checks).")
+                    "(AST rules APX001-APX007 + traced jaxpr checks).")
     p.add_argument("paths", nargs="*", default=["apex_tpu"],
                    help="files or directories to lint (default: apex_tpu)")
     p.add_argument("--json", action="store_true", dest="as_json",

@@ -135,6 +135,75 @@ def _amp_train_step_monitored():
     return monitored, args, allowed
 
 
+def _tp_overlap_layers():
+    """Sequence-parallel Column→Row pair with ``overlap_comm=True``,
+    forward AND backward: the ring collective-matmul path
+    (``parallel/overlap.py``) whose ppermutes must ride the tensor
+    axis — a wrong axis here would silently exchange shards with the
+    wrong neighbours and trace clean."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from apex_tpu._compat import shard_map
+    from apex_tpu.transformer.tensor_parallel import (
+        ColumnParallelLinear, RowParallelLinear)
+
+    mesh, _, _ = _mesh_for(tp=2)
+    col = ColumnParallelLinear(input_size=8, output_size=16,
+                               gather_output=False, sequence_parallel=True,
+                               overlap_comm=True)
+    row = RowParallelLinear(input_size=16, output_size=8,
+                            input_is_parallel=True, sequence_parallel=True,
+                            overlap_comm=True)
+
+    def block(x):
+        vc = col.init(jax.random.PRNGKey(0), x)
+        h = col.apply(vc, x)
+        vr = row.init(jax.random.PRNGKey(1), h)
+        return row.apply(vr, h)
+
+    def loss_and_grad(x):
+        def loss(x):
+            return jnp.sum(block(x) ** 2)
+        return loss(x), jax.grad(loss)(x)
+
+    fn = shard_map(loss_and_grad, mesh=mesh, in_specs=(P(),),
+                   out_specs=(P(), P()), check_vma=False)
+    x = jnp.zeros((4, 8), jnp.float32)
+    return fn, (x,), mesh.axis_names
+
+
+def _ddp_bucketed_step():
+    """Bucketed-DDP gradient accumulation (``overlap.accumulate_gradients``):
+    per-microbatch message_size-bucket psums over the data axis,
+    interleaved with the next microbatch's compute."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from apex_tpu._compat import shard_map
+    from apex_tpu.parallel.overlap import accumulate_gradients
+    from apex_tpu.transformer import parallel_state as ps
+
+    mesh, _, _ = _mesh_for()
+
+    def grad_fn(p, mb):
+        def loss(p):
+            return jnp.mean((jnp.tanh(mb @ p["w1"]) @ p["w2"]) ** 2)
+        return jax.grad(loss)(p)
+
+    def run(p, mb0, mb1):
+        return accumulate_gradients(grad_fn, p, (mb0, mb1),
+                                    axis_name=ps.DATA_AXIS,
+                                    message_size=100, overlap_comm=True)
+
+    fn = shard_map(run, mesh=mesh, in_specs=(P(), P(), P()),
+                   out_specs=P(), check_vma=False)
+    params = {"w1": jnp.zeros((4, 8), jnp.float32),
+              "w2": jnp.zeros((8, 2), jnp.float32)}
+    mb = jnp.zeros((2, 4), jnp.float32)
+    return fn, (params, mb, mb), mesh.axis_names
+
+
 def _fused_lm_head_ce():
     """Vocab-parallel fused LM-head CE: the pmax/psum trio over the
     tensor axis, plus the Pallas kernels in interpret mode."""
@@ -163,5 +232,7 @@ def _fused_lm_head_ce():
 register_entrypoint("amp_train_step", _amp_train_step)
 register_entrypoint("amp_train_step_monitored", _amp_train_step_monitored)
 register_entrypoint("tensor_parallel_layers", _tensor_parallel_layers)
+register_entrypoint("tp_overlap_layers", _tp_overlap_layers)
+register_entrypoint("ddp_bucketed_step", _ddp_bucketed_step)
 register_entrypoint("pipeline_schedule", _pipeline_schedule)
 register_entrypoint("fused_lm_head_ce", _fused_lm_head_ce)

@@ -1,4 +1,4 @@
-"""apexlint AST rules APX001-APX006: TPU/JAX correctness invariants.
+"""apexlint AST rules APX001-APX007: TPU/JAX correctness invariants.
 
 Each rule targets a bug class that bites late on TPU — at import, at
 trace time, or silently in an XLA program — and moves the failure to a
@@ -430,6 +430,101 @@ def _bad_default(ctx: FileContext, node: ast.expr) -> Optional[str]:
             if (tail in _ARRAY_CONSTRUCTORS or path.startswith("jax.random.")):
                 return f"`{path}(...)`"
     return None
+
+
+@register_rule(
+    "APX007", "undonated-train-step",
+    "jitted step taking optimizer/param state without donate_argnums")
+def check_undonated_train_step(ctx: FileContext) -> Iterable[Finding]:
+    """A jitted train step that threads params/optimizer state through
+    itself without donating them doubles the weight+state HBM footprint:
+    XLA must keep the input buffers alive while writing the outputs.
+    ``amp/frontend.py:327-388`` (``make_train_step(donate=True)``) is the
+    house convention — any jit whose wrapped function takes state-shaped
+    arguments must say *something* about donation (an explicit
+    ``donate_argnums=()`` is a conscious opt-out and stays silent)."""
+    donate_kwargs = {"donate_argnums", "donate_argnames"}
+    jit_paths = {"jax.jit", "jax.pmap"}
+    # defs by name, for resolving the jax.jit(f, ...) call form
+    defs = {n.name: n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    def state_args(fn: ast.FunctionDef) -> list:
+        names = [a.arg for a in fn.args.posonlyargs + fn.args.args
+                 + fn.args.kwonlyargs]
+        hits = [n for n in names if n in _STATE_PARAM_NAMES]
+        # only step-shaped jits are in scope: two state trees threaded
+        # together (params + opt_state — something is being updated), or
+        # one state tree alongside grads / a step/train/update-named
+        # def. A lone `predict(params, batch)` or `apply(state, x)` is
+        # inference — donating there would be wrong, so no finding.
+        steppy = (len(hits) >= 2
+                  or any(n in ("grads", "grad") for n in names)
+                  or any(s in fn.name.lower()
+                         for s in ("step", "train", "update")))
+        return hits if (hits and steppy) else []
+
+    def finding(node, fn, hits):
+        return Finding(
+            code="APX007", path=ctx.path, line=node.lineno,
+            col=node.col_offset,
+            message=f"`{fn.name}` is jitted with state arguments "
+                    f"({', '.join(hits)}) but no donate_argnums/"
+                    "donate_argnames: the input buffers stay alive across "
+                    "the step, doubling the params+state HBM footprint — "
+                    "donate them (the make_train_step(donate=True) "
+                    "convention) or pass donate_argnums=() to opt out "
+                    "explicitly")
+
+    seen: set = set()
+    for node in ast.walk(ctx.tree):
+        # decorator forms: @jax.jit / @functools.partial(jax.jit, ...)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    path = ctx.imports.resolve(dec.func)
+                    target = None
+                    if path in jit_paths:
+                        target = dec
+                    elif (path in ("functools.partial", "partial")
+                          and dec.args
+                          and ctx.imports.resolve(dec.args[0]) in jit_paths):
+                        target = dec
+                    if target is None:
+                        continue
+                    if any(kw.arg in donate_kwargs for kw in target.keywords):
+                        continue
+                    hits = state_args(node)
+                    if hits and id(dec) not in seen:
+                        seen.add(id(dec))
+                        yield finding(dec, node, hits)
+                elif ctx.imports.resolve(dec) in jit_paths:
+                    hits = state_args(node)
+                    if hits and id(dec) not in seen:
+                        seen.add(id(dec))
+                        yield finding(dec, node, hits)
+        # call form: jax.jit(step, ...) with step defined in this file
+        elif isinstance(node, ast.Call):
+            if ctx.imports.resolve(node.func) not in jit_paths:
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Name):
+                continue
+            fn = defs.get(node.args[0].id)
+            if fn is None:
+                continue
+            if any(kw.arg in donate_kwargs for kw in node.keywords):
+                continue
+            hits = state_args(fn)
+            if hits and id(node) not in seen:
+                seen.add(id(node))
+                yield finding(node, fn, hits)
+
+
+_STATE_PARAM_NAMES = frozenset({
+    "params", "param_tree", "state", "opt_state", "opt_states",
+    "optimizer_state", "scaler_state", "sstate", "train_state",
+    "model_state",
+})
 
 
 @register_rule(

@@ -357,7 +357,17 @@ class FusedOptimizerBase:
                 return p, st, ss
 
             if self._jit_step is None:
-                self._jit_step = jax.jit(_full, static_argnums=(0,))
+                # donate_argnums=() is deliberate (the APX007 opt-out):
+                # self.params ALIASES param_groups[*]["params"] (set by
+                # initialize_state), and param_groups is not rewritten
+                # after a step — donating here would leave the groups
+                # holding deleted buffers, so a later add_param_group/
+                # initialize_state cycle dereferences dead arrays on
+                # backends with real donation. The donation convention
+                # lives in make_train_step(donate=True), whose caller
+                # owns the whole (params, opt_state, scaler) tuple.
+                self._jit_step = jax.jit(_full, static_argnums=(0,),
+                                         donate_argnums=())
             self.params, self.state, self._scaler.state = self._jit_step(
                 _mon.traced_enabled(), self.params, self.state,
                 self._scaler.state, grads)

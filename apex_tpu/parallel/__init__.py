@@ -10,6 +10,12 @@ from apex_tpu.parallel.distributed import (  # noqa: F401
     allreduce_gradients,
     flat_dist_call,
 )
+from apex_tpu.parallel.overlap import (  # noqa: F401
+    accumulate_gradients,
+    all_gather_matmul,
+    bucketed_allreduce,
+    matmul_reduce_scatter,
+)
 from apex_tpu.parallel.sync_batchnorm import (  # noqa: F401
     SyncBatchNorm,
     convert_syncbn_model,

@@ -14,7 +14,11 @@ under ``shard_map`` over the ``tensor`` mesh axis — and degrade to plain
 dense/embedding at tp=1. The reference's async-allreduce-overlapped-
 with-weight-grad trick (:221-234) needs no code here: XLA's latency-hiding
 scheduler overlaps the backward ``psum`` with the weight-gradient matmul
-automatically.
+automatically. The *blocking* sequence-parallel collectives, though —
+all-gather→matmul and matmul→reduce-scatter, where the dependency chain
+defeats any scheduler — get explicit overlap via ``overlap_comm=True``:
+the ring collective-matmul forms from ``apex_tpu/parallel/overlap.py``
+(off by default; the default jaxpr is byte-identical to the fused form).
 
 Per-partition init matches the reference's ``_initialize_affine_weight``
 strategy (:59-124): the full weight is materialized deterministically from
@@ -29,9 +33,25 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.parallel import overlap
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.tensor_parallel import mappings
 from apex_tpu.transformer.tensor_parallel.utils import divide, VocabUtility
+from apex_tpu.utils.parity import warn_inert_once as _warn_inert_once
+
+# One-time notice (the inert-knob convention, ``utils/parity``):
+# ``overlap_comm=True`` only has an overlapped form on the
+# sequence-parallel paths — the non-SP copy/psum mappings are already
+# overlapped by XLA's scheduler (no blocking collective→matmul chain to
+# decompose), so the flag would be silently a no-op there without this.
+# Warned inline from ``__call__`` (no helper frame) so the stacklevel
+# points as close to the caller as flax's apply machinery allows.
+_OVERLAP_WITHOUT_SP_MSG = (
+    "{cls}: overlap_comm=True has no effect without "
+    "sequence_parallel=True — only the blocking sequence-parallel "
+    "all-gather→matmul / matmul→reduce-scatter patterns have ring-"
+    "overlapped forms (parallel/overlap.py); the non-SP mappings "
+    "already overlap under XLA's scheduler")
 
 
 def set_tensor_model_parallel_attributes(param, is_parallel: bool, dim: int, stride: int = 1):
@@ -121,25 +141,43 @@ class ColumnParallelLinear(nn.Module):
     skip_bias_add: bool = False
     sequence_parallel: bool = False
     sequence_dim: int = 0          # 0 = [s, b, h] (Megatron), 1 = [b, s, h]
+    overlap_comm: bool = False     # SP only: ring collective-matmul fwd+bwd
     axis_name: str = ps.TENSOR_AXIS
     init_method: Callable = nn.initializers.lecun_normal()
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
+        if self.overlap_comm and not self.sequence_parallel:
+            _warn_inert_once(
+                _OVERLAP_WITHOUT_SP_MSG.format(cls="ColumnParallelLinear"),
+                key="ColumnParallelLinear.overlap_comm_without_sp")
         world = ps._axis_size(self.axis_name)
         out_per = divide(self.output_size, world)
         kernel = self.param(
             "kernel",
             _sliced_init(self.init_method, (self.input_size, self.output_size), 1, self.axis_name),
             (self.input_size, out_per), self.param_dtype)
+        y = None
         if self.sequence_parallel and world > 1:
-            x = mappings.gather_from_sequence_parallel_region(
-                x, self.axis_name, self.sequence_dim)
+            if self.overlap_comm:
+                # explicit comms/compute overlap (parallel/overlap.py):
+                # the sequence all-gather is ring-decomposed so each
+                # ppermute hop hides behind the previous shard's partial
+                # matmul; the custom_vjp backward uses the conjugate
+                # matmul→reduce-scatter ring. Off (default) this layer's
+                # jaxpr is byte-identical to the blocking form.
+                y = overlap.all_gather_matmul(
+                    x, kernel.astype(x.dtype), self.axis_name,
+                    self.sequence_dim)
+            else:
+                x = mappings.gather_from_sequence_parallel_region(
+                    x, self.axis_name, self.sequence_dim)
         elif world > 1:
             x = mappings.copy_to_tensor_model_parallel_region(x, self.axis_name)
-        y = jnp.dot(x, kernel.astype(x.dtype),
-                    preferred_element_type=jnp.float32).astype(x.dtype)
+        if y is None:
+            y = jnp.dot(x, kernel.astype(x.dtype),
+                        preferred_element_type=jnp.float32).astype(x.dtype)
         bias = None
         if self.use_bias:
             bias = self.param(
@@ -171,12 +209,17 @@ class RowParallelLinear(nn.Module):
     skip_bias_add: bool = False
     sequence_parallel: bool = False
     sequence_dim: int = 0          # 0 = [s, b, h] (Megatron), 1 = [b, s, h]
+    overlap_comm: bool = False     # SP only: ring collective-matmul fwd+bwd
     axis_name: str = ps.TENSOR_AXIS
     init_method: Callable = nn.initializers.lecun_normal()
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
+        if self.overlap_comm and not self.sequence_parallel:
+            _warn_inert_once(
+                _OVERLAP_WITHOUT_SP_MSG.format(cls="RowParallelLinear"),
+                key="RowParallelLinear.overlap_comm_without_sp")
         world = ps._axis_size(self.axis_name)
         in_per = divide(self.input_size, world)
         kernel = self.param(
@@ -185,14 +228,24 @@ class RowParallelLinear(nn.Module):
             (in_per, self.output_size), self.param_dtype)
         if not self.input_is_parallel and world > 1:
             x = mappings.scatter_to_tensor_model_parallel_region(x, self.axis_name)
-        y = jnp.dot(x, kernel.astype(x.dtype),
-                    preferred_element_type=jnp.float32).astype(x.dtype)
-        if world > 1:
-            if self.sequence_parallel:
-                y = mappings.reduce_scatter_to_sequence_parallel_region(
-                    y, self.axis_name, self.sequence_dim)
-            else:
-                y = mappings.reduce_from_tensor_model_parallel_region(y, self.axis_name)
+        if self.sequence_parallel and world > 1 and self.overlap_comm:
+            # transpose pattern of the column layer's overlap: the
+            # sequence reduce-scatter is ring-decomposed, each partial
+            # matmul hiding the travelling accumulator's ppermute hop.
+            # Reassociates the cross-rank sum — dtype-tolerance parity
+            # with the fused psum_scatter, not bitwise.
+            y = overlap.matmul_reduce_scatter(
+                x, kernel.astype(x.dtype), self.axis_name,
+                self.sequence_dim)
+        else:
+            y = jnp.dot(x, kernel.astype(x.dtype),
+                        preferred_element_type=jnp.float32).astype(x.dtype)
+            if world > 1:
+                if self.sequence_parallel:
+                    y = mappings.reduce_scatter_to_sequence_parallel_region(
+                        y, self.axis_name, self.sequence_dim)
+                else:
+                    y = mappings.reduce_from_tensor_model_parallel_region(y, self.axis_name)
         bias = None
         if self.use_bias:
             bias = self.param("bias", nn.initializers.zeros,

@@ -15,6 +15,17 @@ TPU: each pair is a ``jax.custom_vjp`` over ``lax`` collectives, usable
 inside ``shard_map`` over the ``tensor`` mesh axis. Under pure GSPMD
 (sharding constraints) these are implicit; this explicit layer exists for
 Megatron API parity and for kernels that need manual collectives.
+
+The sequence-parallel pairs here are *blocking*: the consumer matmul
+cannot start until ``gather_from_sequence_parallel_region`` lands, and
+``reduce_scatter_to_sequence_parallel_region`` cannot start until the
+producer matmul finishes. When the collective is immediately adjacent to
+a matmul, prefer the fused ring forms —
+:func:`apex_tpu.parallel.overlap.all_gather_matmul` /
+:func:`apex_tpu.parallel.overlap.matmul_reduce_scatter` (re-exported
+below) — which decompose the collective into ppermute hops overlapped
+with per-shard partial matmuls; ``ColumnParallelLinear`` /
+``RowParallelLinear`` select them via ``overlap_comm=True``.
 """
 
 from __future__ import annotations
@@ -25,6 +36,8 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.monitor import hooks as _mon
+from apex_tpu.parallel.overlap import (  # noqa: F401  (fused SP forms)
+    all_gather_matmul, matmul_reduce_scatter)
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu._compat import axis_size as _axis_size
 

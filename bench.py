@@ -954,6 +954,162 @@ def _bench_dispatch_overhead():
             "noop_iqr_ms": round(iqr * 1e3, 2)}
 
 
+def _bench_tp_overlap():
+    """Collective-matmul evidence (PR 4): (a) numeric parity of the ring
+    ``all_gather_matmul``/``matmul_reduce_scatter`` against the blocking
+    gather→matmul / matmul→reduce-scatter forms on whatever mesh this
+    host offers (single chip: both degrade to the same plain matmul —
+    recorded as mesh_axis_size=1), (b) the virtual-8-device jaxpr
+    structure via an AbstractMesh trace — no devices needed — showing
+    tp-1 = 7 ppermutes replacing the one blocking all_gather, and (c)
+    the monitor's trace-time ppermute byte/count accounting for the
+    overlapped program (a temporarily-attached traced-hooks recorder;
+    the bench's own host-only observer stays in place around it)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import AbstractMesh, Mesh, PartitionSpec as P
+
+    from apex_tpu import monitor
+    from apex_tpu._compat import shard_map
+    from apex_tpu.lint.jaxpr_checks import iter_eqns
+    from apex_tpu.parallel.overlap import (all_gather_matmul,
+                                           matmul_reduce_scatter)
+
+    out = {}
+    ndev = len(jax.devices())
+    tp = max(t for t in (8, 4, 2, 1) if t <= ndev)
+    out["mesh_axis_size"] = tp
+    s, h, n = 8 * tp, 64, 64
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(s, h), jnp.float32)
+    w = jnp.asarray(rng.randn(h, n), jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:tp]), ("tensor",))
+
+    def both(xs, w):
+        ref = jnp.dot(jax.lax.all_gather(xs, "tensor", axis=0, tiled=True),
+                      w, preferred_element_type=jnp.float32)
+        ag = all_gather_matmul(xs, w, "tensor", 0)
+        y = jnp.dot(xs, w.T, preferred_element_type=jnp.float32)
+        ref_rs = jax.lax.psum_scatter(y, "tensor", scatter_dimension=0,
+                                      tiled=True)
+        rs = matmul_reduce_scatter(xs, w.T, "tensor", 0)
+        # the rs outputs are per-rank shards (rank i holds block i), so
+        # the error scalar is rank-varying: pmax it, or the P() output
+        # would silently record only rank 0's shard as "parity"
+        rs_err = jax.lax.pmax(jnp.max(jnp.abs(ref_rs - rs)), "tensor")
+        return (jnp.max(jnp.abs(ref - ag)), rs_err)
+
+    ag_err, rs_err = shard_map(
+        both, mesh=mesh, in_specs=(P("tensor"), P()),
+        out_specs=(P(), P()), check_vma=False)(x, w)
+    out["all_gather_matmul_max_abs_err"] = float(ag_err)
+    out["matmul_reduce_scatter_max_abs_err"] = float(rs_err)
+
+    # virtual-8 jaxpr structure: trace-only, independent of real devices
+    am = AbstractMesh((("tensor", 8),))
+    x8 = jnp.zeros((32, h), jnp.float32)
+    w8 = jnp.zeros((h, n), jnp.float32)
+
+    def counts(fn):
+        jx = jax.make_jaxpr(shard_map(
+            fn, mesh=am, in_specs=(P("tensor"), P()), out_specs=P(),
+            check_vma=False))(x8, w8)
+        names = [e.primitive.name for e in iter_eqns(jx.jaxpr)]
+        return {k: names.count(k)
+                for k in ("ppermute", "all_gather", "reduce_scatter")}
+
+    rec = monitor.Recorder(name="bench-tp-overlap", capacity=1024)
+    with monitor.attached(rec):
+        out["jaxpr_tp8_overlapped"] = counts(
+            lambda a, b: all_gather_matmul(a, b, "tensor", 0))
+    out["jaxpr_tp8_blocking"] = counts(
+        lambda a, b: jnp.dot(
+            jax.lax.all_gather(a, "tensor", axis=0, tiled=True), b))
+    out["monitor_ppermute"] = rec.collectives().get("ppermute@tensor")
+    return {"tp_overlap": out}
+
+
+def _bench_ddp_bucket_overlap():
+    """Bucketed gradient-allreduce evidence (PR 4): parity of the
+    streamed per-microbatch bucket psums and the delayed bucketed flush
+    against the per-leaf allreduce, plus the virtual-8 jaxpr bucket
+    structure (one fused psum eqn per message_size bucket per microbatch)
+    and the monitor's per-bucket psum accounting."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import AbstractMesh, Mesh, PartitionSpec as P
+
+    from apex_tpu import monitor
+    from apex_tpu._compat import shard_map
+    from apex_tpu.lint.jaxpr_checks import iter_eqns
+    from apex_tpu.parallel.distributed import allreduce_gradients
+    from apex_tpu.parallel.overlap import (accumulate_gradients,
+                                           bucket_partition)
+
+    ndev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    rng = np.random.RandomState(1)
+    params = {"w1": jnp.asarray(rng.randn(16, 32) * 0.2, jnp.float32),
+              "w2": jnp.asarray(rng.randn(32, 4) * 0.2, jnp.float32)}
+    mbs = tuple(jnp.asarray(rng.randn(4, 16), jnp.float32)
+                for _ in range(3))
+    message_size = 1024   # w1 = 2048 B closes a bucket, w2 = 512 B next
+
+    def grad_fn(p, mb):
+        def loss(p):
+            return jnp.mean((jnp.tanh(mb @ p["w1"]) @ p["w2"]) ** 2)
+        return jax.grad(loss)(p)
+
+    def run(**kw):
+        def inner(p, *mbs):
+            return accumulate_gradients(grad_fn, p, mbs, axis_name="data",
+                                        message_size=message_size, **kw)
+        return shard_map(inner, mesh=mesh, in_specs=(P(),) * (1 + len(mbs)),
+                         out_specs=P(), check_vma=False)(params, *mbs)
+
+    base = run(overlap_comm=False)
+    streamed = run(overlap_comm=True)
+    delayed = run(overlap_comm=True, delay_allreduce=True)
+
+    def maxerr(a, b):
+        return max(float(jnp.max(jnp.abs(a[k] - b[k]))) for k in a)
+
+    leaves, _ = jax.tree.flatten(params)
+    n_buckets = len(bucket_partition(leaves, message_size))
+    out = {"world_size": ndev, "message_size": message_size,
+           "n_buckets": n_buckets, "n_microbatches": len(mbs),
+           "streamed_vs_perleaf_max_abs_err": maxerr(base, streamed),
+           "delayed_vs_perleaf_max_abs_err": maxerr(base, delayed)}
+
+    # virtual-8 jaxpr: psum-eqn counts per mode + monitor accounting
+    am = AbstractMesh((("data", 8),))
+
+    def psums(attach=None, **kw):
+        def inner(p, *mbs):
+            return accumulate_gradients(grad_fn, p, mbs, axis_name="data",
+                                        message_size=message_size, **kw)
+        tracer = lambda: jax.make_jaxpr(shard_map(
+            inner, mesh=am, in_specs=(P(),) * (1 + len(mbs)),
+            out_specs=P(), check_vma=False))(params, *mbs)
+        if attach is not None:
+            with monitor.attached(attach):
+                jx = tracer()
+        else:
+            jx = tracer()
+        return sum(1 for e in iter_eqns(jx.jaxpr)
+                   if e.primitive.name == "psum")
+
+    rec = monitor.Recorder(name="bench-ddp-bucket", capacity=1024)
+    out["jaxpr_tp8_psums_streamed"] = psums(attach=rec, overlap_comm=True)
+    out["jaxpr_tp8_psums_delayed"] = psums(overlap_comm=True,
+                                           delay_allreduce=True)
+    out["jaxpr_tp8_psums_perleaf"] = psums(overlap_comm=False)
+    out["monitor_bucket_psum"] = rec.collectives().get("psum@data")
+    return {"ddp_bucket_overlap": out}
+
+
 def _bench_gpt_moe():
     """GPT with every-other-block MoE (8 experts, dense mesh —
     single-chip expert compute): the expert-parallel surface's
@@ -1312,6 +1468,8 @@ def _sections_full(ctx: dict, rec) -> list:
         ("ring_s32k", 2400, lambda: {"ring_s32k": _bench_ring_s32k()}),
         ("dispatch_overhead", 300,
          lambda: {"dispatch_overhead": _bench_dispatch_overhead()}),
+        ("tp_overlap", 300, _bench_tp_overlap),
+        ("ddp_bucket_overlap", 300, _bench_ddp_bucket_overlap),
         ("monitor", 120, lambda: _monitor_extras(rec)),
     ]
     return sections
@@ -1320,7 +1478,8 @@ def _sections_full(ctx: dict, rec) -> list:
 # every section a --smoke run must leave in the stream, even when one is
 # forcibly timed out (the probe) — asserted after the run
 SMOKE_EXPECTED = ("smoke_mlp_amp", "smoke_fused_adam",
-                  "smoke_noop_dispatch", "smoke_timeout_probe", "monitor")
+                  "smoke_noop_dispatch", "tp_overlap", "ddp_bucket_overlap",
+                  "smoke_timeout_probe", "monitor")
 
 
 def _sections_smoke(ctx: dict, rec) -> list:
@@ -1400,6 +1559,11 @@ def _sections_smoke(ctx: dict, rec) -> list:
         ("smoke_mlp_amp", 300, mlp_amp),
         ("smoke_fused_adam", 120, fused_adam),
         ("smoke_noop_dispatch", 60, noop),
+        # the overlap sections run the same code in smoke and full: tiny
+        # shapes, parity on whatever mesh exists, virtual-8 jaxprs via
+        # AbstractMesh (trace-only — works on one CPU device)
+        ("tp_overlap", 120, _bench_tp_overlap),
+        ("ddp_bucket_overlap", 120, _bench_ddp_bucket_overlap),
         ("smoke_timeout_probe", probe_budget, timeout_probe),
         ("monitor", 60, lambda: _monitor_extras(rec)),
     ]

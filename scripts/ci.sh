@@ -7,7 +7,7 @@
 #   CI_SKIP_TESTS=1 scripts/ci.sh # lint + selfcheck only (quick loop)
 #
 # Stages:
-#   1. lint        — scripts/lint.sh (AST rules APX001-APX006 + the
+#   1. lint        — scripts/lint.sh (AST rules APX001-APX007 + the
 #                    traced-entrypoint collective-axis checks, which
 #                    include the monitor-instrumented amp step)
 #   2. tier-1      — the ROADMAP tier-1 pytest command (CPU, 8 virtual
@@ -48,6 +48,24 @@ echo "== ci: bench streaming-evidence smoke =="
 ( cd /tmp && JAX_PLATFORMS=cpu PYTHONPATH="$REPO_DIR" \
     BENCH_STREAM_PATH=/tmp/ci_bench_smoke_stream.jsonl \
     python "$REPO_DIR/bench.py" --smoke > /tmp/ci_bench_smoke.json ) || fail=1
+
+echo "== ci: overlap bench sections in the evidence stream =="
+# the PR-4 sections must land as flushed section lines (bench --smoke
+# already asserts SMOKE_EXPECTED; this is the independent driver-side
+# check of the same contract)
+python - /tmp/ci_bench_smoke_stream.jsonl <<'EOF' || fail=1
+import json, sys
+seen = set()
+for line in open(sys.argv[1]):
+    ev = json.loads(line)
+    if ev.get("kind") == "section":
+        seen.add(ev.get("name"))
+missing = {"tp_overlap", "ddp_bucket_overlap"} - seen
+if missing:
+    print(f"ci: overlap sections missing from bench stream: {sorted(missing)}")
+    raise SystemExit(1)
+print("ci: tp_overlap + ddp_bucket_overlap present in bench stream")
+EOF
 
 if [[ "$fail" == "0" ]]; then
   echo "ci: all gates green"

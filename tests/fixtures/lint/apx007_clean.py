@@ -1,0 +1,37 @@
+"""APX007 clean fixture: donation stated (or no state threaded)."""
+import functools
+
+import jax
+
+
+def train_step(params, opt_state, batch):
+    return params, opt_state
+
+
+step = jax.jit(train_step, donate_argnums=(0, 1))
+
+# an explicit empty donate_argnums is a conscious opt-out, not a finding
+step_undonated = jax.jit(train_step, donate_argnums=())
+
+
+@functools.partial(jax.jit, donate_argnames=("params",))
+def update(params, grads):
+    return params
+
+
+@jax.jit
+def predict(x):
+    return x * 2
+
+
+@jax.jit
+def forward(params, batch):
+    # one state tree, no grads, not step-named: inference — donating
+    # params here would be WRONG, so the rule stays silent
+    return batch @ params
+
+
+@jax.jit
+def apply(state, x):
+    # likewise for a bare `state` helper: not necessarily the hot loop
+    return state

@@ -1,0 +1,14 @@
+"""APX007 fixture: suppressed via inline disable."""
+import jax
+
+
+def train_step(params, opt_state, batch):
+    return params, opt_state
+
+
+step = jax.jit(train_step)  # apexlint: disable=APX007
+
+
+@jax.jit  # apexlint: disable=APX007
+def update(params, grads):
+    return params

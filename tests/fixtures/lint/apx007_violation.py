@@ -1,0 +1,21 @@
+"""APX007 fixture: jitted train steps that never mention donation."""
+import functools
+
+import jax
+
+
+def train_step(params, opt_state, batch):
+    return params, opt_state
+
+
+step = jax.jit(train_step, static_argnums=())
+
+
+@jax.jit
+def update(params, grads):
+    return params
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def apply_updates(_cfg, state, grads):
+    return state

@@ -424,3 +424,51 @@ def test_bwd_two_kernel_fallback_matches_fused(monkeypatch, features):
     for a, r in zip(g_fused, g_two):
         np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                    rtol=1e-5, atol=1e-5)
+
+
+def test_inherited_bwd_blocks_warns_once():
+    """Explicit forward blocks silently governed the backward (ADVICE r5)
+    — now they warn, once, and only when the backward blocks are left to
+    inherit; passing block_q_bwd/block_k_bwd stays silent."""
+    import warnings
+    from apex_tpu.utils import parity
+
+    q, k, v = _qkv(sq=32, sk=32)
+    key = "flash_attention.inherited_bwd_blocks"
+    parity._seen.discard(key)
+    with pytest.warns(UserWarning, match="govern the BACKWARD"):
+        flash_attention(q, k, v, block_q=16, block_k=16)
+    # once per process: second call is silent
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        flash_attention(q, k, v, block_q=16, block_k=16)
+    # explicit backward blocks: no inheritance, no warning
+    parity._seen.discard(key)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        flash_attention(q, k, v, block_q=16, block_k=16,
+                        block_q_bwd=16, block_k_bwd=16)
+        # defaults (no explicit forward blocks) stay silent too
+        flash_attention(q, k, v)
+
+
+def test_fmha_shim_does_not_trip_inherited_blocks_warning():
+    """fmha_varlen states its backward blocks explicitly: the library's
+    own shim must neither warn (unactionable through its API) nor
+    consume the once-per-process key a real user call should get."""
+    import warnings
+    from apex_tpu.contrib.fmha import fmha_varlen
+    from apex_tpu.utils import parity
+
+    parity._seen.discard("flash_attention.inherited_bwd_blocks")
+    rng = np.random.RandomState(3)
+    total, h, d = 32, 2, 16
+    qkv = jnp.asarray(rng.randn(total, 3, h, d), jnp.float32)
+    cu = jnp.asarray([0, 16, 32], jnp.int32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        fmha_varlen(qkv, cu, block=16)
+    # the key is still free for a genuine implicit-backward user call
+    with pytest.warns(UserWarning, match="govern the BACKWARD"):
+        q, k, v = _qkv(sq=32, sk=32)
+        flash_attention(q, k, v, block_q=16, block_k=16)

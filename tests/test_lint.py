@@ -21,7 +21,8 @@ from apex_tpu.lint.cli import main as cli_main
 FIXTURES = Path(__file__).parent / "fixtures" / "lint"
 PACKAGE_ROOT = Path(__file__).parent.parent / "apex_tpu"
 
-RULE_CODES = ["APX001", "APX002", "APX003", "APX004", "APX005", "APX006"]
+RULE_CODES = ["APX001", "APX002", "APX003", "APX004", "APX005", "APX006",
+              "APX007"]
 
 
 def _lint_fixture(name):
@@ -165,6 +166,8 @@ def test_entrypoints_actually_trace_collectives():
 
     try:
         for name, want in [("tensor_parallel_layers", "tensor"),
+                           ("tp_overlap_layers", "tensor"),
+                           ("ddp_bucketed_step", "data"),
                            ("pipeline_schedule", "pipeline"),
                            ("fused_lm_head_ce", "tensor")]:
             fn, args, _ = ENTRYPOINTS[name]()

@@ -1,0 +1,634 @@
+"""Explicit comms/compute overlap (``apex_tpu/parallel/overlap.py``) on
+the 8-device virtual mesh.
+
+Three contracts, per the PR-4 acceptance bar:
+
+1. **Parity**: the ring collective-matmul primitives and the bucketed
+   gradient all-reduce compute the same values as the blocking forms
+   they replace — fwd and bwd, fp32 and bf16 (``all_gather_matmul`` and
+   the bucketed psums bitwise; the reduce-scatter ring reassociates the
+   cross-rank sum, so dtype tolerance there).
+2. **Structure**: with ``overlap_comm`` on, the jaxpr shows the
+   decomposed form — ≥ tp-1 ``ppermute``s and zero ``all_gather``s for
+   the gather direction, one fused ``psum`` per bucket for DDP. With it
+   off (the default), the program is byte-identical to the pre-overlap
+   path (asserted as str(jaxpr) equality against the hand-written loop,
+   and as exact collective multisets for the layers).
+3. **Accounting**: trace-time ``ppermute`` bytes/counts land in the
+   monitor's collective table (which previously only ever saw
+   psum/all_gather/psum_scatter).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu._compat import shard_map
+from apex_tpu.lint.jaxpr_checks import iter_eqns
+from apex_tpu.parallel import (
+    DistributedDataParallel, accumulate_gradients, allreduce_gradients,
+    bucketed_allreduce)
+from apex_tpu.parallel.overlap import (
+    all_gather_matmul, bucket_partition, matmul_reduce_scatter)
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear, RowParallelLinear, mappings)
+
+TP = 4
+
+
+@pytest.fixture
+def tp_mesh():
+    ps.destroy_model_parallel()
+    mesh = ps.initialize_model_parallel(tensor_model_parallel_size_=TP)
+    yield mesh
+    ps.destroy_model_parallel()
+
+
+def _data_mesh():
+    return Mesh(np.array(jax.devices()), ("data",))
+
+
+def _eqn_count(jaxpr, name):
+    return sum(1 for e in iter_eqns(jaxpr) if e.primitive.name == name)
+
+
+def _normalized(jaxpr_str):
+    """jaxpr text with memory addresses scrubbed: custom_vjp eqn params
+    embed bound-function reprs whose id changes per trace."""
+    import re
+    return re.sub(r"0x[0-9a-f]+", "0xADDR", jaxpr_str)
+
+
+def _tol(dtype):
+    return dict(rtol=2e-2, atol=2e-2) if dtype == jnp.bfloat16 \
+        else dict(rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# collective-matmul primitives vs the blocking mappings path
+# ---------------------------------------------------------------------------
+
+
+# bf16 variants ride the slow tier (~10 s of compile each on CPU);
+# tier-1 keeps the fp32 parity + the bf16 bucket-sizing/partition tests
+_DTYPES = [jnp.float32,
+           pytest.param(jnp.bfloat16, marks=pytest.mark.slow)]
+
+
+@pytest.mark.parametrize("dtype", _DTYPES)
+def test_all_gather_matmul_fwd_bwd_parity(tp_mesh, dtype):
+    """fwd+bwd of the gather ring vs gather_from_sequence_parallel_region
+    + dot — the plain Column-SP path. Each ring block is the same full
+    contraction, so the forward is exact; the backward runs the conjugate
+    reduce-scatter ring (reassociated sum → tolerance)."""
+    rng = np.random.RandomState(0)
+    s, h, n = 8, 16, 12   # s is the FULL sequence; per-rank shard s/TP
+    x = jnp.asarray(rng.randn(s, h), dtype)
+    w = jnp.asarray(rng.randn(h, n) * 0.3, dtype)
+
+    def plain(xs, w):
+        g = mappings.gather_from_sequence_parallel_region(xs, "tensor", 0)
+        return jnp.dot(g, w, preferred_element_type=jnp.float32).astype(
+            xs.dtype)
+
+    def fused(xs, w):
+        return all_gather_matmul(xs, w, "tensor", 0)
+
+    def run(fn):
+        def inner(x, w):
+            def loss(xs, w):
+                return jnp.sum(fn(xs, w).astype(jnp.float32) ** 2)
+            l, grads = jax.value_and_grad(loss, argnums=(0, 1))(x, w)
+            return l, *grads
+        return shard_map(inner, mesh=tp_mesh, in_specs=(P("tensor"), P()),
+                         out_specs=(P(), P("tensor"), P()),
+                         check_vma=False)(x, w)
+
+    l0, dx0, dw0 = run(plain)
+    l1, dx1, dw1 = run(fused)
+    np.testing.assert_array_equal(np.asarray(l0), np.asarray(l1))
+    np.testing.assert_allclose(np.asarray(dx0, np.float32),
+                               np.asarray(dx1, np.float32), **_tol(dtype))
+    np.testing.assert_allclose(np.asarray(dw0, np.float32),
+                               np.asarray(dw1, np.float32), **_tol(dtype))
+
+
+@pytest.mark.parametrize("dtype", _DTYPES)
+def test_matmul_reduce_scatter_fwd_bwd_parity(tp_mesh, dtype):
+    """fwd+bwd of the scatter ring vs dot +
+    reduce_scatter_to_sequence_parallel_region — the plain Row-SP path."""
+    rng = np.random.RandomState(1)
+    s, h, n = 8, 16, 12
+    x = jnp.asarray(rng.randn(s, h), dtype)          # replicated [s, h_loc]
+    w = jnp.asarray(rng.randn(h, n) * 0.3, dtype)
+
+    def plain(x, w):
+        y = jnp.dot(x, w, preferred_element_type=jnp.float32).astype(x.dtype)
+        return mappings.reduce_scatter_to_sequence_parallel_region(
+            y, "tensor", 0)
+
+    def fused(x, w):
+        return matmul_reduce_scatter(x, w, "tensor", 0)
+
+    def run(fn):
+        def inner(x, w):
+            def loss(x, w):
+                return jax.lax.psum(
+                    jnp.sum(fn(x, w).astype(jnp.float32) ** 2), "tensor")
+            l, grads = jax.value_and_grad(loss, argnums=(0, 1))(x, w)
+            return l, *grads
+        return shard_map(inner, mesh=tp_mesh, in_specs=(P(), P()),
+                         out_specs=(P(), P(), P()), check_vma=False)(x, w)
+
+    l0, dx0, dw0 = run(plain)
+    l1, dx1, dw1 = run(fused)
+    np.testing.assert_allclose(float(l0), float(l1), **_tol(dtype))
+    np.testing.assert_allclose(np.asarray(dx0, np.float32),
+                               np.asarray(dx1, np.float32), **_tol(dtype))
+    np.testing.assert_allclose(np.asarray(dw0, np.float32),
+                               np.asarray(dw1, np.float32), **_tol(dtype))
+
+
+def test_all_gather_matmul_batch_first_dim(tp_mesh):
+    """gather_dim=1: the [b, s, h] layout (sequence_dim=1 layers)."""
+    rng = np.random.RandomState(2)
+    b, s, h, n = 3, 8, 6, 10
+    x = jnp.asarray(rng.randn(b, s, h), jnp.float32)
+
+    def inner(xs, w):
+        ref = jnp.dot(jax.lax.all_gather(xs, "tensor", axis=1, tiled=True),
+                      w, preferred_element_type=jnp.float32)
+        return ref, all_gather_matmul(xs, w, "tensor", 1)
+
+    w = jnp.asarray(rng.randn(h, n), jnp.float32)
+    ref, got = shard_map(inner, mesh=tp_mesh,
+                         in_specs=(P(None, "tensor"), P()),
+                         out_specs=(P(), P()), check_vma=False)(x, w)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_primitive_validation():
+    with pytest.raises(ValueError, match="weight must be 2D"):
+        all_gather_matmul(jnp.ones((4, 8)), jnp.ones((8, 2, 1)), "tensor", 0)
+    with pytest.raises(ValueError, match="contraction mismatch"):
+        all_gather_matmul(jnp.ones((4, 8)), jnp.ones((7, 2)), "tensor", 0)
+    with pytest.raises(ValueError, match="non-contraction axis"):
+        matmul_reduce_scatter(jnp.ones((4, 8)), jnp.ones((8, 2)), "tensor", 1)
+
+
+# ---------------------------------------------------------------------------
+# layer wiring: overlap_comm flag
+# ---------------------------------------------------------------------------
+
+
+def _sp_block(overlap, s=8, h=16, n=32):
+    col = ColumnParallelLinear(input_size=h, output_size=n,
+                               gather_output=False, sequence_parallel=True,
+                               overlap_comm=overlap)
+    row = RowParallelLinear(input_size=n, output_size=h,
+                            input_is_parallel=True, sequence_parallel=True,
+                            overlap_comm=overlap)
+
+    def block(xs):
+        vc = col.init(jax.random.PRNGKey(0), xs)
+        hid = col.apply(vc, xs)
+        vr = row.init(jax.random.PRNGKey(1), hid)
+        return row.apply(vr, hid)
+
+    return block
+
+
+@pytest.mark.slow
+def test_sp_layers_overlap_matches_plain(tp_mesh):
+    """Column→Row sequence-parallel sandwich: overlap_comm on/off agree
+    on loss (bitwise — the only reassociation is in the Row reduce,
+    which both paths do in fp32-accumulated x-dtype) and grads.
+
+    Slow tier (52 s of tp=4 compile on CPU): tier-1 keeps the same
+    fwd+bwd numerics covered at the primitive level
+    (test_*_fwd_bwd_parity) and the layer wiring covered structurally
+    (test_sp_layers_jaxpr_structure)."""
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(8, 16), jnp.float32)
+
+    def run(overlap):
+        block = _sp_block(overlap)
+
+        def inner(xs):
+            def loss(xs):
+                return jnp.sum(block(xs) ** 2)
+            return loss(xs), jax.grad(loss)(xs)
+
+        return shard_map(inner, mesh=tp_mesh, in_specs=(P("tensor"),),
+                         out_specs=(P(), P("tensor")), check_vma=False)(x)
+
+    l0, g0 = run(False)
+    l1, g1 = run(True)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g0), np.asarray(g1),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sp_layers_jaxpr_structure(tp_mesh):
+    """Off (default): the exact blocking collective multiset of today's
+    layers — all_gather + psum_scatter, zero ppermutes. On: ≥ tp-1
+    ppermutes replace every blocking sequence collective (fwd AND bwd)."""
+    x = jnp.asarray(np.random.RandomState(4).randn(8, 16), jnp.float32)
+
+    def trace(overlap):
+        block = _sp_block(overlap)
+
+        def inner(xs):
+            def loss(xs):
+                return jnp.sum(block(xs) ** 2)
+            return jax.value_and_grad(loss)(xs)
+
+        return jax.make_jaxpr(
+            shard_map(inner, mesh=tp_mesh, in_specs=(P("tensor"),),
+                      out_specs=(P(), P("tensor")), check_vma=False))(x)
+
+    off = trace(False)
+    assert _eqn_count(off.jaxpr, "ppermute") == 0
+    assert _eqn_count(off.jaxpr, "all_gather") >= 1
+    # lax.psum_scatter traces as the reduce_scatter primitive
+    assert _eqn_count(off.jaxpr, "reduce_scatter") >= 1
+
+    on = trace(True)
+    assert _eqn_count(on.jaxpr, "all_gather") == 0
+    assert _eqn_count(on.jaxpr, "reduce_scatter") == 0
+    assert _eqn_count(on.jaxpr, "ppermute") >= TP - 1
+
+
+def test_layer_default_is_off_byte_identical(tp_mesh):
+    """The overlap_comm default: constructing the layers without the new
+    field traces the very same program as overlap_comm=False."""
+    x = jnp.asarray(np.random.RandomState(5).randn(8, 16), jnp.float32)
+
+    def trace(**kw):
+        col = ColumnParallelLinear(input_size=16, output_size=32,
+                                   gather_output=False,
+                                   sequence_parallel=True, **kw)
+
+        def fwd(xs):
+            v = col.init(jax.random.PRNGKey(0), xs)
+            return col.apply(v, xs)
+
+        return _normalized(str(jax.make_jaxpr(
+            shard_map(fwd, mesh=tp_mesh, in_specs=(P("tensor"),),
+                      out_specs=P("tensor"), check_vma=False))(x)))
+
+    assert trace() == trace(overlap_comm=False)
+
+
+# ---------------------------------------------------------------------------
+# bucketed gradient all-reduce
+# ---------------------------------------------------------------------------
+
+
+def _grad_tree(rng, dtype=jnp.float32):
+    return {
+        "w1": jnp.asarray(rng.randn(6, 5), dtype),        # 120 B fp32
+        "b1": jnp.asarray(rng.randn(5), dtype),           # 20 B
+        "step": jnp.asarray(7, jnp.int32),                # non-floating
+        "w2": jnp.asarray(rng.randn(100), dtype),         # 400 B — straddler
+        "b2": jnp.asarray(rng.randn(3), dtype),           # 12 B
+    }
+
+
+def test_bucket_partition_semantics():
+    leaves, _ = jax.tree.flatten(_grad_tree(np.random.RandomState(0)))
+    # tree order: b1(20B), b2(12B), step(int), w1(120B), w2(400B)
+    # message_size=32: b1 fills past 32 only with b2 → [b1,b2], then w1
+    # alone (120 ≥ 32), then w2 alone. Straddling leaves stay whole.
+    buckets = bucket_partition(leaves, 32)
+    sizes = [[int(leaves[i].size) for i in b] for b in buckets]
+    assert sizes == [[5, 3], [30], [100]]
+    # non-floating leaves are in no bucket
+    bucketed = {i for b in buckets for i in b}
+    int_idx = [i for i, g in enumerate(leaves)
+               if not jnp.issubdtype(g.dtype, jnp.floating)]
+    assert not (bucketed & set(int_idx))
+    # one-bucket case: everything fits
+    assert len(bucket_partition(leaves, 1 << 30)) == 1
+    # minimum size: every float leaf its own bucket
+    assert len(bucket_partition(leaves, 1)) == 4
+    # fp32-upcast sizing doubles bf16 wire bytes: the same tree splits
+    # into twice the buckets once the upcast is priced in
+    half = [jnp.ones((4,), jnp.bfloat16)] * 4        # 8 B each, 16 B on wire
+    assert len(bucket_partition(half, 32)) == 1
+    assert len(bucket_partition(half, 32, allreduce_always_fp32=True)) == 2
+    assert len(bucket_partition(half, 33, allreduce_always_fp32=True)) == 2
+    assert len(bucket_partition(half, 33)) == 1
+    with pytest.raises(ValueError):
+        bucket_partition(half, 0)
+
+
+@pytest.mark.parametrize("message_size", [1, 64, 1 << 30])
+def test_bucketed_allreduce_matches_per_leaf(message_size):
+    """Bucketing changes grouping, not any leaf's reduction: bitwise
+    parity with allreduce_gradients across bucket counts (4-bucket,
+    straddling, one-bucket)."""
+    mesh = _data_mesh()
+    grads = _grad_tree(np.random.RandomState(6))
+
+    def both(g):
+        return (allreduce_gradients(g, "data"),
+                bucketed_allreduce(g, "data", message_size=message_size))
+
+    r1, r2 = shard_map(both, mesh=mesh, in_specs=(P(),),
+                       out_specs=(P(), P()), check_vma=False)(grads)
+    for k in grads:
+        np.testing.assert_array_equal(np.asarray(r1[k]), np.asarray(r2[k]))
+
+
+def test_bucketed_allreduce_scaling_options():
+    """predivide / no-average / fp32-upcast combinations match the
+    per-leaf path bitwise (same per-leaf math, different grouping)."""
+    mesh = _data_mesh()
+    n = len(jax.devices())
+    grads = {"a": jnp.full((4,), 1.5, jnp.bfloat16),
+             "b": jnp.asarray(np.random.RandomState(7).randn(9), jnp.float32)}
+    for kw in (dict(gradient_predivide_factor=float(n)),
+               dict(gradient_average=False),
+               dict(allreduce_always_fp32=True),
+               dict(allreduce_always_fp32=True, gradient_average=False,
+                    gradient_predivide_factor=2.0)):
+        def both(g):
+            return (allreduce_gradients(g, "data", **kw),
+                    bucketed_allreduce(g, "data", message_size=8, **kw))
+        r1, r2 = shard_map(both, mesh=mesh, in_specs=(P(),),
+                           out_specs=(P(), P()), check_vma=False)(grads)
+        for k in grads:
+            np.testing.assert_array_equal(np.asarray(r1[k]),
+                                          np.asarray(r2[k]))
+            assert r1[k].dtype == r2[k].dtype == grads[k].dtype
+
+
+def test_bucketed_allreduce_one_psum_per_bucket():
+    mesh = _data_mesh()
+    grads = _grad_tree(np.random.RandomState(8))
+    leaves, _ = jax.tree.flatten(grads)
+    for message_size in (1, 32, 1 << 30):
+        n_buckets = len(bucket_partition(leaves, message_size))
+        jx = jax.make_jaxpr(shard_map(
+            lambda g: bucketed_allreduce(g, "data",
+                                         message_size=message_size),
+            mesh=mesh, in_specs=(P(),), out_specs=P(),
+            check_vma=False))(grads)
+        assert _eqn_count(jx.jaxpr, "psum") == n_buckets
+
+
+# ---------------------------------------------------------------------------
+# gradient accumulation: streamed bucket psums vs the delayed flush
+# ---------------------------------------------------------------------------
+
+
+def _acc_setup(n_micro=3, seed=9):
+    rng = np.random.RandomState(seed)
+    params = {"w1": jnp.asarray(rng.randn(4, 8) * 0.3, jnp.float32),
+              "w2": jnp.asarray(rng.randn(8, 2) * 0.3, jnp.float32)}
+    mbs = tuple(jnp.asarray(rng.randn(2, 4), jnp.float32)
+                for _ in range(n_micro))
+
+    def grad_fn(p, mb):
+        def loss(p):
+            return jnp.mean((jnp.tanh(mb @ p["w1"]) @ p["w2"]) ** 2)
+        return jax.grad(loss)(p)
+
+    return params, mbs, grad_fn
+
+
+def test_accumulate_modes_agree():
+    mesh = _data_mesh()
+    params, mbs, grad_fn = _acc_setup()
+
+    def run(**kw):
+        def inner(p, *mbs):
+            return accumulate_gradients(grad_fn, p, mbs, axis_name="data",
+                                        message_size=64, **kw)
+        return shard_map(inner, mesh=mesh, in_specs=(P(),) * (1 + len(mbs)),
+                         out_specs=P(), check_vma=False)(params, *mbs)
+
+    base = run(overlap_comm=False)
+    streamed = run(overlap_comm=True, delay_allreduce=False)
+    delayed = run(overlap_comm=True, delay_allreduce=True)
+    for k in params:
+        # delayed bucketing reduces the same accumulated leaves: bitwise
+        np.testing.assert_array_equal(np.asarray(base[k]),
+                                      np.asarray(delayed[k]))
+        # streamed reassociates (psum per microbatch): fp tolerance
+        np.testing.assert_allclose(np.asarray(base[k]),
+                                   np.asarray(streamed[k]),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_accumulate_off_is_byte_identical_to_manual_loop():
+    """overlap_comm=False is the hand-written accumulate-then-allreduce
+    program, byte for byte — the DDP half of the `off == today` bar."""
+    mesh = _data_mesh()
+    params, mbs, grad_fn = _acc_setup()
+
+    def helper(p, *mbs):
+        return accumulate_gradients(grad_fn, p, mbs, axis_name="data",
+                                    overlap_comm=False)
+
+    def manual(p, *mbs):
+        acc = None
+        for mb in mbs:
+            g = grad_fn(p, mb)
+            acc = g if acc is None else jax.tree.map(jnp.add, acc, g)
+        return allreduce_gradients(acc, "data")
+
+    specs = (P(),) * (1 + len(mbs))
+    j1 = jax.make_jaxpr(shard_map(helper, mesh=mesh, in_specs=specs,
+                                  out_specs=P(), check_vma=False))(
+        params, *mbs)
+    j2 = jax.make_jaxpr(shard_map(manual, mesh=mesh, in_specs=specs,
+                                  out_specs=P(), check_vma=False))(
+        params, *mbs)
+    assert str(j1) == str(j2)
+
+
+def test_accumulate_streamed_psum_counts():
+    """Streamed: one psum per bucket per microbatch, each issued in the
+    program before the next microbatch's compute (the overlap window);
+    delayed: one per bucket total."""
+    mesh = _data_mesh()
+    params, mbs, grad_fn = _acc_setup()
+    leaves, _ = jax.tree.flatten(params)
+    n_buckets = len(bucket_partition(leaves, 64))
+    assert n_buckets == 2   # w1 128 B ≥ 64 closes; w2 64 B
+
+    def trace(**kw):
+        def inner(p, *mbs):
+            return accumulate_gradients(grad_fn, p, mbs, axis_name="data",
+                                        message_size=64, **kw)
+        return jax.make_jaxpr(shard_map(
+            inner, mesh=mesh, in_specs=(P(),) * (1 + len(mbs)),
+            out_specs=P(), check_vma=False))(params, *mbs)
+
+    streamed = trace(overlap_comm=True, delay_allreduce=False)
+    assert _eqn_count(streamed.jaxpr, "psum") == n_buckets * len(mbs)
+    delayed = trace(overlap_comm=True, delay_allreduce=True)
+    assert _eqn_count(delayed.jaxpr, "psum") == n_buckets
+    off = trace(overlap_comm=False)
+    n_float = sum(1 for g in leaves
+                  if jnp.issubdtype(g.dtype, jnp.floating))
+    assert _eqn_count(off.jaxpr, "psum") == n_float   # today's per-leaf form
+
+
+def test_ddp_wrapper_bucketed_flush_and_accumulate():
+    mesh = _data_mesh()
+    params, mbs, grad_fn = _acc_setup()
+    ddp_off = DistributedDataParallel(lambda p, x: x)
+    ddp_on = DistributedDataParallel(lambda p, x: x, overlap_comm=True,
+                                     message_size=64)
+    grads = grad_fn(params, mbs[0])
+
+    def inner(g):
+        return ddp_off.sync(g), ddp_on.sync(g)
+
+    r_off, r_on = shard_map(inner, mesh=mesh, in_specs=(P(),),
+                            out_specs=(P(), P()), check_vma=False)(grads)
+    for k in grads:
+        np.testing.assert_array_equal(np.asarray(r_off[k]),
+                                      np.asarray(r_on[k]))
+
+    def acc(p, *mbs):
+        return ddp_on.accumulate(grad_fn, p, mbs)
+
+    got = shard_map(acc, mesh=mesh, in_specs=(P(),) * (1 + len(mbs)),
+                    out_specs=P(), check_vma=False)(params, *mbs)
+    want = shard_map(lambda p, *m: accumulate_gradients(
+        grad_fn, p, m, axis_name="data", message_size=64,
+        overlap_comm=True), mesh=mesh, in_specs=(P(),) * (1 + len(mbs)),
+        out_specs=P(), check_vma=False)(params, *mbs)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(got[k]),
+                                      np.asarray(want[k]))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("message_size", [1, 16, 48, 128, 512, 1 << 20])
+@pytest.mark.parametrize("n_micro", [1, 2, 5])
+def test_accumulate_exhaustive_sweep(message_size, n_micro):
+    """Exhaustive bucket-size × microbatch-count sweep (slow tier; the
+    representative cases above run in tier-1)."""
+    mesh = _data_mesh()
+    params, mbs, grad_fn = _acc_setup(n_micro=n_micro, seed=message_size % 97)
+
+    def run(**kw):
+        def inner(p, *mbs):
+            return accumulate_gradients(grad_fn, p, mbs, axis_name="data",
+                                        message_size=message_size, **kw)
+        return shard_map(inner, mesh=mesh, in_specs=(P(),) * (1 + len(mbs)),
+                         out_specs=P(), check_vma=False)(params, *mbs)
+
+    base = run(overlap_comm=False)
+    for kw in (dict(overlap_comm=True),
+               dict(overlap_comm=True, delay_allreduce=True)):
+        got = run(**kw)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(base[k]),
+                                       np.asarray(got[k]),
+                                       rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# monitor: trace-time ppermute accounting
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_counts_ppermute_bytes(tp_mesh):
+    from apex_tpu import monitor
+
+    x = jnp.asarray(np.random.RandomState(10).randn(8, 16), jnp.float32)
+    w = jnp.asarray(np.random.RandomState(11).randn(16, 12), jnp.float32)
+    rec = monitor.Recorder(name="overlap-test")
+    with monitor.attached(rec):
+        jax.make_jaxpr(shard_map(
+            lambda xs, w: all_gather_matmul(xs, w, "tensor", 0),
+            mesh=tp_mesh, in_specs=(P("tensor"), P()), out_specs=P(),
+            check_vma=False))(x, w)
+    table = rec.collectives()
+    assert "ppermute@tensor" in table, table
+    entry = table["ppermute@tensor"]
+    # tp-1 hops, each carrying the [s/tp, h] fp32 shard
+    assert entry["count"] == TP - 1
+    assert entry["bytes"] == (TP - 1) * (8 // TP) * 16 * 4
+
+
+def test_monitor_counts_bucket_psums():
+    from apex_tpu import monitor
+
+    mesh = _data_mesh()
+    grads = _grad_tree(np.random.RandomState(12))
+    leaves, _ = jax.tree.flatten(grads)
+    n_buckets = len(bucket_partition(leaves, 32))
+    rec = monitor.Recorder(name="overlap-test")
+    with monitor.attached(rec):
+        jax.make_jaxpr(shard_map(
+            lambda g: bucketed_allreduce(g, "data", message_size=32),
+            mesh=mesh, in_specs=(P(),), out_specs=P(),
+            check_vma=False))(grads)
+    table = rec.collectives()
+    assert table["psum@data"]["count"] == n_buckets
+    float_bytes = sum(g.size * g.dtype.itemsize for g in leaves
+                      if jnp.issubdtype(g.dtype, jnp.floating))
+    assert table["psum@data"]["bytes"] == float_bytes
+
+
+def test_overlap_disabled_monitor_adds_no_ops(tp_mesh):
+    """The accounting is trace-time host bookkeeping: attaching a
+    recorder must not change the traced program (jaxpr purity, the
+    disabled-mode contract of docs/observability.md)."""
+    from apex_tpu import monitor
+
+    x = jnp.asarray(np.random.RandomState(13).randn(8, 16), jnp.float32)
+    w = jnp.asarray(np.random.RandomState(14).randn(16, 12), jnp.float32)
+
+    def trace():
+        return _normalized(str(jax.make_jaxpr(shard_map(
+            lambda xs, w: all_gather_matmul(xs, w, "tensor", 0),
+            mesh=tp_mesh, in_specs=(P("tensor"), P()), out_specs=P(),
+            check_vma=False))(x, w)))
+
+    bare = trace()
+    with monitor.attached(monitor.Recorder(name="purity")):
+        instrumented = trace()
+    assert bare == instrumented
+
+
+def test_overlap_comm_without_sp_warns_once(tp_mesh):
+    """The inert-knob convention: overlap_comm=True on a NON-sequence-
+    parallel layer has no overlapped form to select and must say so
+    (once) instead of silently tracing the blocking path."""
+    import warnings
+    from apex_tpu.utils import parity
+
+    x = jnp.asarray(np.random.RandomState(20).randn(4, 16), jnp.float32)
+    for key in ("ColumnParallelLinear.overlap_comm_without_sp",
+                "RowParallelLinear.overlap_comm_without_sp"):
+        parity._seen.discard(key)
+    col = ColumnParallelLinear(input_size=16, output_size=32,
+                               overlap_comm=True)
+    with pytest.warns(UserWarning, match="no effect without "
+                                         "sequence_parallel"):
+        shard_map(lambda xs: col.apply(
+            col.init(jax.random.PRNGKey(0), xs), xs),
+            mesh=tp_mesh, in_specs=(P(),), out_specs=P(),
+            check_vma=False)(x)
+    # SP + overlap_comm is the live path: silent
+    sp_col = ColumnParallelLinear(input_size=16, output_size=32,
+                                  gather_output=False,
+                                  sequence_parallel=True,
+                                  overlap_comm=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        shard_map(lambda xs: sp_col.apply(
+            sp_col.init(jax.random.PRNGKey(0), xs), xs),
+            mesh=tp_mesh, in_specs=(P("tensor"),),
+            out_specs=P(None, "tensor"), check_vma=False)(x)

@@ -168,13 +168,28 @@ def test_flat_dist_call():
 
 def test_ddp_inert_knob_warning():
     """CUDA-runtime tuning knobs are accepted for parity but warn once
-    (apex/parallel/distributed.py:129-170 option surface)."""
+    (apex/parallel/distributed.py:129-170 option surface). Since the
+    bucketed-psum path (PR 4), ``message_size`` is only inert while
+    ``overlap_comm=False`` — the warning says how to make it live, and
+    goes away entirely when it IS live."""
     import warnings as _w
     from apex_tpu.utils import parity
     parity._seen.clear()
     with pytest.warns(UserWarning, match="no-op on TPU"):
         DistributedDataParallel(lambda p, x: x, num_allreduce_streams=4,
                                 message_size=1 << 20)
+    # message_size alone (overlap_comm off): inert, and the warning
+    # points at the flag that makes it real
+    parity._seen.clear()
+    with pytest.warns(UserWarning, match="overlap_comm=True"):
+        DistributedDataParallel(lambda p, x: x, message_size=1 << 20)
+    # with overlap_comm=True message_size is LIVE: no warning for it
+    # (streams/communicators would still warn — they have no TPU analog)
+    parity._seen.clear()
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        DistributedDataParallel(lambda p, x: x, message_size=1 << 20,
+                                overlap_comm=True)
     # defaults stay silent
     parity._seen.clear()
     with _w.catch_warnings():
