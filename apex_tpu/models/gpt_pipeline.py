@@ -68,7 +68,9 @@ from apex_tpu.transformer import parallel_state as ps
 from apex_tpu._compat import axis_size as _axis_size
 from apex_tpu.transformer.pipeline_parallel.schedules import (
     forward_backward_pipelining_1f1b_interleaved_model,
-    forward_backward_pipelining_1f1b_model, pipeline_apply_interleaved,
+    forward_backward_pipelining_1f1b_model,
+    forward_backward_pipelining_zb_interleaved_model,
+    forward_backward_pipelining_zb_model, pipeline_apply_interleaved,
     staged_group_scan)
 from apex_tpu.transformer.tensor_parallel import (
     ColumnParallelLinear, VocabParallelEmbedding,
@@ -394,8 +396,53 @@ class PipelinedGPT:
         return self._loss_and_grads_1f1b_common(
             params, ids_mb, labels_mb, loss_scale, interleaved=True)
 
+    def loss_and_grads_zb(self, params, ids_mb, labels_mb,
+                          loss_scale: Optional[jax.Array] = None,
+                          wgrad_stash: Optional[int] = None,
+                          remat_policy=None):
+        """Zero-bubble (split-backward) 1F1B for the full GPT.
+
+        Same contract and constraints as ``loss_and_grads_1f1b``
+        (n_chunks == 1, dense blocks, no SP) but through
+        ``forward_backward_pipelining_zb_model``: the per-tick backward
+        computes only the stage-input cotangent (the ring dependency),
+        and the weight gradients run in a dense post-scan flush —
+        ``2(P-1)`` masked wgrad units of bubble compute removed per
+        rank, grads bit-for-bit the 1F1B computation reordered.
+        ``wgrad_stash``: ``None`` = full deferral (``2·nmb`` extra
+        microbatch activations of stash), ``0`` = eager (exact 1F1B
+        memory), ``K`` = bounded. ``remat_policy`` (e.g. ``"dots"``)
+        controls what each unit's pullback saves vs recomputes.
+        """
+        if self.n_chunks != 1:
+            raise ValueError(
+                f"the plain zero-bubble schedule is non-interleaved: "
+                f"n_chunks must be 1, got {self.n_chunks} (use "
+                f"loss_and_grads_zb_interleaved)")
+        return self._loss_and_grads_1f1b_common(
+            params, ids_mb, labels_mb, loss_scale, interleaved=False,
+            schedule="zb", wgrad_stash=wgrad_stash,
+            remat_policy=remat_policy)
+
+    def loss_and_grads_zb_interleaved(self, params, ids_mb, labels_mb,
+                                      loss_scale: Optional[jax.Array]
+                                      = None,
+                                      wgrad_stash: Optional[int] = None,
+                                      remat_policy=None):
+        """Interleaved (vpp) zero-bubble: the split-backward treatment
+        of ``loss_and_grads_1f1b_interleaved`` — same contract, wgrad
+        stream deferred to the dense flush (``wgrad_stash`` supports
+        ``None``/``0`` on the interleaved variant)."""
+        return self._loss_and_grads_1f1b_common(
+            params, ids_mb, labels_mb, loss_scale, interleaved=True,
+            schedule="zb", wgrad_stash=wgrad_stash,
+            remat_policy=remat_policy)
+
     def _loss_and_grads_1f1b_common(self, params, ids_mb, labels_mb,
-                                    loss_scale, interleaved: bool):
+                                    loss_scale, interleaved: bool,
+                                    schedule: str = "1f1b",
+                                    wgrad_stash: Optional[int] = None,
+                                    remat_policy=None):
         if self.has_moe:
             raise ValueError("1F1B paths do not carry the MoE aux "
                              "channel; use loss_and_grads")
@@ -419,12 +466,21 @@ class PipelinedGPT:
         sched_params = {"embed": params["embed"],
                         "stage": params["chunks"],
                         "head": params["head"]}
+        zb = schedule == "zb"
         if interleaved:
             # chunk leaves are [V, L, ...]; the schedule indexes chunk c
             # and hands stage_fn the [L, ...] slice it already scans
-            loss, g = forward_backward_pipelining_1f1b_interleaved_model(
-                embed_fn, self.stage_fn, loss_fn, sched_params,
-                (ids_mb, labels_mb), nmb, self.n_chunks, self.axis_name)
+            if zb:
+                loss, g = forward_backward_pipelining_zb_interleaved_model(
+                    embed_fn, self.stage_fn, loss_fn, sched_params,
+                    (ids_mb, labels_mb), nmb, self.n_chunks,
+                    self.axis_name, wgrad_stash=wgrad_stash,
+                    remat_policy=remat_policy)
+            else:
+                loss, g = forward_backward_pipelining_1f1b_interleaved_model(
+                    embed_fn, self.stage_fn, loss_fn, sched_params,
+                    (ids_mb, labels_mb), nmb, self.n_chunks,
+                    self.axis_name)
         else:
             def stage_fn(stage_params, h):
                 # chunk leaves are [1, L, ...]: squeeze the chunk dim and
@@ -433,9 +489,15 @@ class PipelinedGPT:
                 return self.stage_fn(
                     jax.tree.map(lambda p: p[0], stage_params), h)
 
-            loss, g = forward_backward_pipelining_1f1b_model(
-                embed_fn, stage_fn, loss_fn, sched_params,
-                (ids_mb, labels_mb), nmb, self.axis_name)
+            if zb:
+                loss, g = forward_backward_pipelining_zb_model(
+                    embed_fn, stage_fn, loss_fn, sched_params,
+                    (ids_mb, labels_mb), nmb, self.axis_name,
+                    wgrad_stash=wgrad_stash, remat_policy=remat_policy)
+            else:
+                loss, g = forward_backward_pipelining_1f1b_model(
+                    embed_fn, stage_fn, loss_fn, sched_params,
+                    (ids_mb, labels_mb), nmb, self.axis_name)
         grads = {"embed": jax.lax.psum(g["embed"], self.axis_name),
                  "chunks": g["stage"],
                  "head": jax.lax.psum(g["head"], self.axis_name)}

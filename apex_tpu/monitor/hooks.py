@@ -135,6 +135,33 @@ def traced_tick(name: str, tick):
         functools.partial(_emit_tick, name), tick, ordered=False)
 
 
+def _emit_tick_marks(name: str, keys, tick, rank, *vals):
+    rec = _state.recorder
+    if rec is not None and getattr(rec, "traced_hooks", True):
+        rec._device_tick_marks(name, tick, rank, dict(zip(keys, vals)))
+
+
+def traced_tick_marks(name: str, tick, rank, **slots):
+    """Record one MEASURED slot-occupancy mark for a pipeline tick.
+
+    ``slots`` are traced booleans, one per unit slot the tick body
+    executes (``f`` = forward unit, ``b`` = backward-input/dgrad unit,
+    ``w`` = backward-weight/wgrad unit); a False slot means the
+    computation ran masked on padding — an idle slot. ``rank`` is the
+    traced pipeline rank, so the aggregated table
+    (``report.aggregate()["pipeline_utilization"]``) is per rank.
+    Inserts one ``jax.debug.callback`` when enabled, nothing otherwise
+    (the disabled-mode purity contract)."""
+    rec = _state.recorder
+    if rec is None or not rec.traced_hooks:
+        return
+    import jax
+    keys = tuple(sorted(slots))
+    jax.debug.callback(
+        functools.partial(_emit_tick_marks, name, keys), tick, rank,
+        *(slots[k] for k in keys), ordered=False)
+
+
 # -- trace-time hooks --------------------------------------------------------
 
 def tree_bytes(tree) -> int:
@@ -171,19 +198,33 @@ def collective(op: str, axis_name, operand=None, *, nbytes: int = None,
 
 
 def pipeline_schedule(schedule: str, n_stages: int, n_microbatches: int,
-                      total_ticks: int, useful_ticks: int = None):
+                      total_ticks: int, useful_ticks: int = None,
+                      useful_slots: int = None, total_slots: int = None):
     """Record a pipeline schedule's geometry and its analytic
     bubble-fraction estimate: the fraction of scan ticks a rank spends
     on padding rather than a real microbatch unit,
     ``1 - useful_ticks / total_ticks`` (``useful_ticks`` defaults to
-    ``n_microbatches`` — one unit per microbatch per stream). Measured
-    per-tick host arrivals come from ``traced_tick`` separately."""
+    ``n_microbatches`` — one unit per microbatch per stream). Schedules
+    with heterogeneous ticks (zero-bubble: the wgrad stream leaves the
+    tick grid) pass ``useful_slots``/``total_slots`` — executed
+    unit-slot counts per rank — and the bubble fraction is
+    ``1 - useful_slots / total_slots`` instead; for the homogeneous
+    schedules the two definitions coincide. Measured per-tick arrivals
+    come from ``traced_tick``/``traced_tick_marks`` separately."""
     rec = _state.recorder
     if rec is None or not rec.traced_hooks:
         return
-    useful = n_microbatches if useful_ticks is None else useful_ticks
-    bubble = 1.0 - (float(useful) / float(total_ticks)) if total_ticks else 0.0
+    extra = {}
+    if useful_slots is not None and total_slots is not None:
+        bubble = 1.0 - (float(useful_slots) / float(total_slots)) \
+            if total_slots else 0.0
+        extra = {"useful_slots": int(useful_slots),
+                 "total_slots": int(total_slots)}
+    else:
+        useful = n_microbatches if useful_ticks is None else useful_ticks
+        bubble = 1.0 - (float(useful) / float(total_ticks)) \
+            if total_ticks else 0.0
     rec.gauge(f"pipeline/{schedule}/bubble_fraction", round(bubble, 6))
     rec._emit("schedule", f"pipeline/{schedule}", total_ticks,
               n_stages=int(n_stages), n_microbatches=int(n_microbatches),
-              bubble_fraction=round(bubble, 6))
+              bubble_fraction=round(bubble, 6), **extra)

@@ -275,6 +275,18 @@ class Recorder:
         except (TypeError, ValueError):
             pass
 
+    def _device_tick_marks(self, name: str, tick, rank, slots: dict):
+        """Target of the measured slot-occupancy marks
+        (``hooks.traced_tick_marks``): one event per (tick, rank) with
+        the boolean validity of every unit slot the tick executed —
+        the raw material of the per-rank pipeline utilization table
+        (``report.aggregate()['pipeline_utilization']``)."""
+        try:
+            self._emit("tick_mark", name, int(tick), rank=int(rank),
+                       slots={k: bool(v) for k, v in slots.items()})
+        except (TypeError, ValueError):
+            pass
+
     # -- per-step records ---------------------------------------------------
     @contextlib.contextmanager
     def step(self, **meta):

@@ -56,6 +56,7 @@ def aggregate(events: Iterable[dict], header: Optional[dict] = None) -> dict:
     timers: dict[str, dict] = {}
     collectives: dict[str, dict] = {}
     schedules: dict[str, dict] = {}
+    utilization: dict[str, dict] = {}
     steps: list[dict] = []
     health: list[dict] = []
     for ev in events:
@@ -80,6 +81,22 @@ def aggregate(events: Iterable[dict], header: Optional[dict] = None) -> dict:
                 "n_stages": ev.get("n_stages"),
                 "n_microbatches": ev.get("n_microbatches"),
                 "bubble_fraction": ev.get("bubble_fraction")}
+        elif kind == "tick_mark":
+            # measured slot occupancy: one mark per (tick, rank), one
+            # boolean per executed unit slot (f/b/w) — see
+            # hooks.traced_tick_marks
+            rank = str(ev.get("rank", 0))
+            row = utilization.setdefault(name, {}).setdefault(
+                rank, {"ticks": 0, "slots_total": 0, "slots_valid": 0,
+                       "by_slot": {}})
+            row["ticks"] += 1
+            for slot, valid in (ev.get("slots") or {}).items():
+                row["slots_total"] += 1
+                s = row["by_slot"].setdefault(slot, {"total": 0, "valid": 0})
+                s["total"] += 1
+                if valid:
+                    s["valid"] += 1
+                    row["slots_valid"] += 1
         elif kind == "step":
             steps.append(ev)
         elif kind == "health_event":
@@ -113,9 +130,33 @@ def aggregate(events: Iterable[dict], header: Optional[dict] = None) -> dict:
     out["collectives"] = {k: collectives[k] for k in sorted(collectives)}
     if schedules:
         out["schedules"] = schedules
+    if utilization:
+        for sched, ranks in utilization.items():
+            tot = val = 0
+            for row in ranks.values():
+                row["idle_fraction"] = round(
+                    1.0 - row["slots_valid"] / row["slots_total"], 6) \
+                    if row["slots_total"] else 0.0
+                tot += row["slots_total"]
+                val += row["slots_valid"]
+            ranks["all"] = {
+                "slots_total": tot, "slots_valid": val,
+                "idle_fraction": round(1.0 - val / tot, 6) if tot else 0.0}
+        out["pipeline_utilization"] = utilization
     if health:
         out["health"] = health
     return out
+
+
+def measured_idle_fraction(agg: dict, schedule: str):
+    """Convenience: the measured all-rank idle-slot fraction of one
+    pipeline schedule from an :func:`aggregate` result (``None`` when
+    the schedule recorded no tick marks). ``schedule`` matches the
+    tick-mark name, e.g. ``"pipeline/zb1"``."""
+    ranks = (agg.get("pipeline_utilization") or {}).get(schedule)
+    if not ranks:
+        return None
+    return ranks["all"]["idle_fraction"]
 
 
 def _fmt(v) -> str:
@@ -191,6 +232,23 @@ def render_report(events: list[dict], header: Optional[dict] = None,
             parts.append(
                 f"| {k} | {v.get('n_stages')} | {v.get('n_microbatches')} "
                 f"| {v.get('total_ticks')} | {v.get('bubble_fraction')} |")
+    if agg.get("pipeline_utilization"):
+        parts.append("\n## pipeline utilization (measured slot "
+                     "occupancy)\n")
+        parts.append("| schedule | rank | ticks | slots | valid | "
+                     "per-slot valid/total | idle |\n"
+                     "|---|---|---|---|---|---|---|")
+        for sched, ranks in agg["pipeline_utilization"].items():
+            order = sorted((r for r in ranks if r != "all"), key=int)
+            for rank in order + ["all"]:
+                row = ranks[rank]
+                per = " ".join(
+                    f"{s}:{v['valid']}/{v['total']}"
+                    for s, v in sorted(row.get("by_slot", {}).items()))
+                parts.append(
+                    f"| {sched} | {rank} | {row.get('ticks', '')} "
+                    f"| {row['slots_total']} | {row['slots_valid']} "
+                    f"| {per} | {row['idle_fraction']} |")
     if agg.get("timers"):
         parts.append("\n## timers\n")
         parts.append("| timer | n | total s | mean s |\n|---|---|---|---|")

@@ -14,6 +14,11 @@ from apex_tpu.transformer.pipeline_parallel.p2p import (  # noqa: F401
     send_backward_recv_backward,
     ring_shift,
 )
+from apex_tpu.transformer.pipeline_parallel.backward_split import (  # noqa: F401,E501
+    dgrad_vjp,
+    wgrad,
+    with_remat_policy,
+)
 from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
     pipeline_apply,
     pipeline_apply_interleaved,
@@ -24,6 +29,10 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (  # noqa: F401
     forward_backward_pipelining_1f1b_model,
     forward_backward_pipelining_1f1b_interleaved,
     forward_backward_pipelining_1f1b_interleaved_model,
+    forward_backward_pipelining_zb,
+    forward_backward_pipelining_zb_model,
+    forward_backward_pipelining_zb_interleaved,
+    forward_backward_pipelining_zb_interleaved_model,
     staged_group_scan,
     get_forward_backward_func,
 )

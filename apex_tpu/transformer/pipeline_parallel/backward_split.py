@@ -1,0 +1,104 @@
+"""Split backward for zero-bubble pipeline schedules (dgrad / wgrad).
+
+Zero Bubble Pipeline Parallelism (Qi et al., 2023) rests on one
+observation: the backward pass of a pipeline stage factors into two
+independent pieces with very different scheduling constraints.
+
+- **dgrad** — the cotangent w.r.t. the stage *input*. This is the only
+  part the previous stage depends on: it rides the reverse ``ppermute``
+  ring and sits on the pipeline's critical path, so it must run at the
+  1F1B "B" tick.
+- **wgrad** — the cotangent w.r.t. the stage *parameters*. It has NO
+  inter-stage consumer: once the ``(input activation, output
+  cotangent)`` pair exists, the weight gradient can be computed at any
+  later point before the optimizer step. The zero-bubble schedules
+  defer it out of the tick-synchronous scan entirely and compute it in
+  a dense post-scan flush where every slot is a real unit of work.
+
+Why that wins in the SPMD-scan formulation: the masked tick body
+executes its full slot set every tick, valid or not. The combined-VJP
+1F1B tick carries forward + dgrad + wgrad, so the ``2(P-1)`` ring
+warmup/cooldown ticks each burn a full (masked, garbage) wgrad. The
+zero-bubble tick carries only forward + dgrad; the nmb wgrads run once
+each in the flush — ``2(P-1)`` wgrad-units of bubble compute removed
+per rank, and the measured idle-slot fraction drops accordingly
+(``docs/perf.md``, "Zero-bubble pipeline").
+
+Cost model caveat: splitting one ``jax.vjp`` into two replays the stage
+forward twice (both pullbacks rematerialize from the stashed input).
+That extra forward is the standard remat trade the 1F1B family already
+makes; XLA fuses each flush step into one large fwd+wgrad program with
+no ring collectives in it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from apex_tpu.utils.remat import resolve_remat_policy
+
+
+def with_remat_policy(stage_fn: Callable, remat_policy=None) -> Callable:
+    """Wrap ``stage_fn`` in ``jax.checkpoint`` under the named (or
+    callable) residual policy from ``apex_tpu.utils.remat``.
+
+    ``None`` returns ``stage_fn`` unchanged — the explicit-VJP schedules
+    already rematerialize everything from the stashed stage input, so
+    the default saves nothing beyond that input. A policy (e.g.
+    ``"dots"``) lets the per-unit pullback keep matmul outputs instead
+    of recomputing them, trading stash-adjacent memory for backward
+    FLOPs; with the deferred-wgrad stash this is the knob that stops
+    the flush from double-paying forwards the policy would have saved
+    (memory trade table: ``docs/perf.md``)."""
+    if remat_policy is None:
+        return stage_fn
+    policy = remat_policy if callable(remat_policy) \
+        else resolve_remat_policy(remat_policy)
+    return jax.checkpoint(stage_fn, policy=policy)
+
+
+def dgrad_vjp(stage_fn: Callable, params, inp):
+    """Forward + input-only pullback: ``(out, pull)`` with
+    ``pull(ct) -> d_input``.
+
+    The parameter cotangent is *not* produced — tracing only the
+    ``inp`` argument keeps the wgrad matmuls out of the tick body's
+    jaxpr instead of relying on DCE to delete them."""
+    return jax.vjp(lambda x: stage_fn(params, x), inp)
+
+
+def wgrad(stage_fn: Callable, params, inp, ct):
+    """Deferred weight gradient: pull ``ct`` back onto ``params``,
+    closed over the saved ``(inp, ct)`` pair.
+
+    Replays the stage forward from ``inp`` (rematerialization — the
+    stash holds activations and cotangents only, never residuals) and
+    computes just the parameter-side backward."""
+    _, pull = jax.vjp(lambda p: stage_fn(p, inp), params)
+    return pull(ct)[0]
+
+
+def normalize_wgrad_stash(wgrad_stash: Optional[int],
+                          n_microbatches: int) -> int:
+    """Resolve the ``wgrad_stash`` knob to an effective slot count K.
+
+    - ``None`` → ``n_microbatches`` (full deferral: every wgrad moves to
+      the post-scan flush; no wgrad slot in the tick body at all).
+    - ``0`` → eager flush: wgrad computed at its dgrad tick — exactly
+      1F1B's compute placement and memory (no deferred stash, no flush).
+    - ``1 <= K < n_microbatches`` → bounded: the stash holds K
+      ``(activation, cotangent)`` pairs; the tick body flushes the
+      oldest entry in-scan once the stash is full, and the last K flush
+      in the post-scan pass. Memory is bounded at ``2·K`` microbatch
+      activations over the eager baseline, but the in-scan wgrad slot
+      returns (masked in bubble ticks), so prefer full deferral unless
+      the stash dominates memory.
+    """
+    if wgrad_stash is None:
+        return int(n_microbatches)
+    k = int(wgrad_stash)
+    if k < 0:
+        raise ValueError(f"wgrad_stash must be >= 0, got {wgrad_stash}")
+    return min(k, int(n_microbatches))

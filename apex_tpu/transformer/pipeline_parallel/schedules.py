@@ -30,14 +30,34 @@ from apex_tpu._compat import axis_size as _axis_size
 from apex_tpu.monitor import hooks as _mon
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.microbatches import resolve_num_microbatches
+from apex_tpu.transformer.pipeline_parallel.backward_split import (
+    dgrad_vjp, normalize_wgrad_stash, wgrad, with_remat_policy)
 from apex_tpu.transformer.pipeline_parallel.p2p import (
     ring_shift, send_backward_recv_backward, send_forward_recv_forward)
+from apex_tpu.utils.remat import resolve_remat_policy
+
+
+def _checkpointed(stage_fn: Callable, remat: bool, remat_policy):
+    """``jax.checkpoint`` wrap for the differentiable schedules:
+    ``remat=True`` recomputes in backward under the named/callable
+    residual policy from ``apex_tpu.utils.remat`` (``None`` = full
+    recompute, the historical behavior)."""
+    if not remat:
+        if remat_policy is not None:
+            raise ValueError(
+                "remat_policy is a jax.checkpoint residual policy and "
+                "has no effect with remat=False; drop the policy or "
+                "enable remat")
+        return stage_fn
+    policy = remat_policy if (remat_policy is None or callable(remat_policy)) \
+        else resolve_remat_policy(remat_policy)
+    return jax.checkpoint(stage_fn, policy=policy)
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x,
                    n_microbatches: int,
                    axis_name: str = ps.PIPELINE_AXIS,
-                   remat: bool = True):
+                   remat: bool = True, remat_policy=None):
     """Run microbatched GPipe fill-drain over the pipeline axis.
 
     ``x``: [n_microbatches, mb, ...] input (consumed by stage 0).
@@ -45,6 +65,9 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
     Returns [n_microbatches, mb, ...] final-stage outputs (valid on the
     last stage; replicate/psum externally if every stage needs them).
     ``n_microbatches`` may be an int or a ``NumMicroBatchesCalculator``.
+    ``remat_policy``: residual policy name/callable for the ``remat``
+    checkpoint (``apex_tpu.utils.remat``; e.g. ``"dots"`` saves matmul
+    outputs instead of recomputing them in backward).
     """
     n_microbatches = resolve_num_microbatches(n_microbatches)
     n_stages = _axis_size(axis_name)
@@ -52,7 +75,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
     total_ticks = n_microbatches + n_stages - 1
     _mon.pipeline_schedule("fill_drain", n_stages, n_microbatches,
                            total_ticks)
-    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    fn = _checkpointed(stage_fn, remat, remat_policy)
 
     h_shape = x.shape[1:]
     init_held = jnp.zeros(h_shape, x.dtype)
@@ -231,7 +254,8 @@ def _embed_pullback(embed_fn, pred, embed_params, in_b, ct):
 
 def forward_backward_pipelining_1f1b(
         stage_fn: Callable, loss_mb: Callable, stage_params, x,
-        n_microbatches: int, axis_name: str = ps.PIPELINE_AXIS):
+        n_microbatches: int, axis_name: str = ps.PIPELINE_AXIS,
+        remat_policy=None):
     """1F1B pipeline: bounded activation memory, O(P·mb) not O(nmb·mb).
 
     The fill-drain schedule above differentiates *through* the scan, so
@@ -285,7 +309,7 @@ def forward_backward_pipelining_1f1b(
         stage_fn,
         lambda _, h, __: loss_mb(h),          # headless loss seed
         {"embed": {}, "stage": stage_params, "head": {}},
-        x, n_microbatches, axis_name)
+        x, n_microbatches, axis_name, remat_policy=remat_policy)
     return loss, grads["stage"]
 
 
@@ -293,7 +317,8 @@ def forward_backward_pipelining_1f1b_model(
         embed_fn: Callable, stage_fn: Callable, loss_fn: Callable,
         params, inputs, n_microbatches: int,
         axis_name: str = ps.PIPELINE_AXIS,
-        debug_axis_probe: Optional[bool] = None):
+        debug_axis_probe: Optional[bool] = None,
+        remat_policy=None):
     """1F1B for a FULL model: embed + stages + loss head, flat memory.
 
     **Contract — embed_fn/loss_fn must carry no pipeline-axis
@@ -344,6 +369,7 @@ def forward_backward_pipelining_1f1b_model(
     schedule — peak activations constant in ``n_microbatches``.
     """
     n_microbatches = resolve_num_microbatches(n_microbatches)
+    stage_fn = with_remat_policy(stage_fn, remat_policy)
     n_stages = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     is_last = rank == n_stages - 1
@@ -425,6 +451,12 @@ def forward_backward_pipelining_1f1b_model(
         }
         loss_sum = loss_sum + loss_val    # zero off the last rank
         held_b = send_backward_recv_backward(dinp, axis_name)
+        # measured slot occupancy: the combined-VJP tick executes one
+        # forward and one full backward (dgrad AND wgrad) per tick, so
+        # the b/w slots share valid_b — the baseline the zero-bubble
+        # schedule's table is compared against
+        _mon.traced_tick_marks("pipeline/1f1b", i, rank,
+                               f=valid_f, b=valid_b, w=valid_b)
 
         return (held_f, held_b, stash, grads, loss_sum), None
 
@@ -437,7 +469,8 @@ def forward_backward_pipelining_1f1b_interleaved_model(
         embed_fn: Callable, stage_fn: Callable, loss_fn: Callable,
         params, inputs, n_microbatches: int, n_chunks: int,
         axis_name: str = ps.PIPELINE_AXIS,
-        debug_axis_probe: Optional[bool] = None):
+        debug_axis_probe: Optional[bool] = None,
+        remat_policy=None):
     """Interleaved (vpp) 1F1B: Megatron's production schedule — virtual
     chunks AND flat activation memory — as one SPMD scan.
 
@@ -489,6 +522,7 @@ def forward_backward_pipelining_1f1b_interleaved_model(
     ``n_microbatches % P == 0`` (the Megatron interleaving constraint).
     """
     n_microbatches = resolve_num_microbatches(n_microbatches)
+    stage_fn = with_remat_policy(stage_fn, remat_policy)
     n_stages = _axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     V = n_chunks
@@ -610,6 +644,8 @@ def forward_backward_pipelining_1f1b_interleaved_model(
         }
         loss_sum = loss_sum + loss_val        # zero off the seeding rank
         held_b = ring_shift(dinp, axis_name, reverse=True, wrap=True)
+        _mon.traced_tick_marks("pipeline/interleaved_1f1b", i, rank,
+                               f=valid_f, b=valid_b, w=valid_b)
 
         return (held_f, held_b, stash, grads, loss_sum), None
 
@@ -621,7 +657,7 @@ def forward_backward_pipelining_1f1b_interleaved_model(
 def forward_backward_pipelining_1f1b_interleaved(
         stage_fn: Callable, loss_mb: Callable, chunk_params, x,
         n_microbatches: int, n_chunks: Optional[int] = None,
-        axis_name: str = ps.PIPELINE_AXIS):
+        axis_name: str = ps.PIPELINE_AXIS, remat_policy=None):
     """Headless interleaved 1F1B (stage stack only) — the vpp analog of
     ``forward_backward_pipelining_1f1b``. ``chunk_params`` leaves stacked
     [n_chunks, ...]; ``loss_mb(out) -> scalar`` per microbatch on the
@@ -638,8 +674,498 @@ def forward_backward_pipelining_1f1b_interleaved(
         stage_fn,
         lambda _, h, __: loss_mb(h),
         {"embed": {}, "stage": chunk_params, "head": {}},
-        x, n_microbatches, n_chunks, axis_name)
+        x, n_microbatches, n_chunks, axis_name,
+        remat_policy=remat_policy)
     return loss, grads["stage"]
+
+
+def forward_backward_pipelining_zb(
+        stage_fn: Callable, loss_mb: Callable, stage_params, x,
+        n_microbatches: int, axis_name: str = ps.PIPELINE_AXIS,
+        wgrad_stash: Optional[int] = None, remat_policy=None):
+    """Zero-bubble (ZB-H1-style) 1F1B: split backward, deferred wgrad.
+
+    Headless special case of
+    :func:`forward_backward_pipelining_zb_model` (identity injection
+    from ``x``, no embed/head parameters), exactly as
+    ``forward_backward_pipelining_1f1b`` is to its ``_model`` form.
+    Same contract as 1F1B (``loss_mb`` per microbatch on the last rank,
+    loss = SUM over microbatches, psum loss/grads externally); see the
+    model variant for the wgrad-deferral semantics and the
+    ``wgrad_stash`` knob. Gradients are bitwise the same computation as
+    1F1B reordered — parity is pinned in ``tests/test_zero_bubble.py``.
+    """
+    loss, grads = forward_backward_pipelining_zb_model(
+        lambda _, x_mb: x_mb,
+        stage_fn,
+        lambda _, h, __: loss_mb(h),
+        {"embed": {}, "stage": stage_params, "head": {}},
+        x, n_microbatches, axis_name,
+        wgrad_stash=wgrad_stash, remat_policy=remat_policy)
+    return loss, grads["stage"]
+
+
+def forward_backward_pipelining_zb_model(
+        embed_fn: Callable, stage_fn: Callable, loss_fn: Callable,
+        params, inputs, n_microbatches: int,
+        axis_name: str = ps.PIPELINE_AXIS,
+        debug_axis_probe: Optional[bool] = None,
+        wgrad_stash: Optional[int] = None, remat_policy=None):
+    """Zero-bubble 1F1B for a FULL model: split backward (ZB-H1).
+
+    Zero Bubble Pipeline Parallelism (Qi et al., 2023) factors each
+    backward unit into **dgrad** (cotangent w.r.t. the stage input — on
+    the pipeline's critical path, feeds the previous stage) and
+    **wgrad** (cotangent w.r.t. the stage params — no inter-stage
+    consumer, schedulable anywhere after its ``(activation, cotangent)``
+    pair exists). This schedule keeps the 1F1B tick grid and ring
+    dependency EXACTLY (dgrad runs at the 1F1B "B" tick; the reverse
+    ``ppermute`` carries the same cotangents on the same ticks) but
+    pulls the wgrad stream out of the tick-synchronous scan:
+
+    - per tick: forward unit (identical to 1F1B) + dgrad-only backward
+      (``backward_split.dgrad_vjp`` — the wgrad matmuls are not traced
+      into the tick body at all), pushing ``(stage input, output
+      cotangent)`` into the deferred-wgrad stash;
+    - after the scan: a dense flush scan computes the deferred wgrads —
+      every flush step is a real unit of work, no masking.
+
+    Why this beats 1F1B here: the masked SPMD tick executes its full
+    slot set on every tick, valid or not, so 1F1B's combined-VJP tick
+    burns a full wgrad on each of the ``2(P-1)`` ring warmup/cooldown
+    ticks. Splitting removes the wgrad slot from those bubble ticks:
+    per-rank executed unit-slots drop from ``3·(nmb + 2(P-1))`` to
+    ``2·(nmb + 2(P-1)) + nmb``, an idle-slot fraction of
+    ``4(P-1)/(3·nmb + 4(P-1))`` vs 1F1B's
+    ``2(P-1)/(nmb + 2(P-1))`` — strictly lower for P > 1 (measured per
+    rank by the ``traced_tick_marks`` table, not just this formula;
+    ``bench.py``'s ``pp_zero_bubble`` section records both).
+
+    ``wgrad_stash`` (the memory knob, ``backward_split.
+    normalize_wgrad_stash``): ``None`` = full deferral (stash holds all
+    ``nmb`` pairs — peak stash memory ``2·nmb`` microbatch activations
+    on top of the 1F1B input stash); ``0`` = eager flush (wgrad at its
+    dgrad tick: exact 1F1B compute placement and memory, no stash, no
+    flush scan); ``1 <= K < nmb`` = bounded (K pairs; the tick body
+    flushes the oldest entry in-scan once full — masked in bubble
+    ticks, so bounded mode trades the compute win back for memory).
+
+    ``remat_policy`` wraps ``stage_fn`` in ``jax.checkpoint`` under the
+    named policy (``apex_tpu.utils.remat``) so the per-unit pullbacks —
+    including the deferred wgrad flush — save policy residuals instead
+    of recomputing everything from the stashed input; the stash itself
+    never double-saves what the policy would recompute (it holds only
+    the ``(input, cotangent)`` pair either way).
+
+    Everything else — the embed/loss contract (**no pipeline-axis
+    collectives**, single-rank ``lax.cond`` branches,
+    ``debug_axis_probe``/``APEX_TPU_PIPELINE_AXIS_PROBE=1``), the
+    ``params`` dict {embed, stage, head}, the masked loss/grads return
+    (psum over the pipeline axis outside) — is the
+    ``forward_backward_pipelining_1f1b_model`` contract verbatim.
+    """
+    n_microbatches = resolve_num_microbatches(n_microbatches)
+    stage_fn = with_remat_policy(stage_fn, remat_policy)
+    n_stages = _axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    is_last = rank == n_stages - 1
+    is_first = rank == 0
+    delay = 2 * (n_stages - 1)
+    total_ticks = n_microbatches + delay
+    K = normalize_wgrad_stash(wgrad_stash, n_microbatches)
+    eager = K == 0
+    in_tick_wgrad = 0 < K < n_microbatches
+    # analytic bubble in executed unit-slots (docstring): every tick
+    # carries f + b slots, a w slot only in eager/bounded mode, and the
+    # flush contributes K fully-valid w slots
+    w_tick_slots = total_ticks if (eager or in_tick_wgrad) else 0
+    _mon.pipeline_schedule(
+        "zb1", n_stages, n_microbatches, total_ticks,
+        useful_slots=3 * n_microbatches,
+        total_slots=2 * total_ticks + w_tick_slots + K)
+    stash_slots = max(1, 2 * n_stages - 1)
+
+    slice_mb = _mb_slicer(inputs)
+
+    h_shape, h_dtype = _probe_h(embed_fn, params["embed"], slice_mb)
+
+    if _axis_probe_enabled(debug_axis_probe):
+        _probe_no_pipeline_collectives(
+            "embed_fn", embed_fn, (params["embed"], slice_mb(0)),
+            axis_name)
+        _probe_no_pipeline_collectives(
+            "loss_fn", loss_fn,
+            (params["head"], jnp.zeros(h_shape, h_dtype), slice_mb(0)),
+            axis_name)
+
+    init = (
+        jnp.zeros(h_shape, h_dtype),                      # held_f
+        jnp.zeros(h_shape, h_dtype),                      # held_b
+        jnp.zeros((stash_slots,) + h_shape, h_dtype),     # input stash
+        # deferred-wgrad stash: K (activation, cotangent) pairs
+        (jnp.zeros((K,) + h_shape, h_dtype),
+         jnp.zeros((K,) + h_shape, h_dtype)) if K else None,
+        jax.tree.map(jnp.zeros_like, params),             # grad accumulator
+        jnp.zeros((), jnp.float32),                       # loss sum
+    )
+
+    def tick(carry, i):
+        held_f, held_b, stash, wstash, grads, loss_sum = carry
+        _mon.traced_tick("pipeline/zb1/tick", i)
+
+        # -- forward unit (identical to 1F1B) ---------------------------
+        m_f = i - rank
+        valid_f = (m_f >= 0) & (m_f < n_microbatches)
+        m_fc = jnp.clip(m_f, 0, n_microbatches - 1)
+        use_inject = valid_f & is_first
+        inject = _embed_inject(embed_fn, use_inject, params["embed"],
+                               slice_mb(m_fc), h_shape, h_dtype)
+        inp = jnp.where(use_inject, inject, held_f)
+        out = stage_fn(params["stage"], inp)
+        slot = m_fc % stash_slots
+        cur = jax.lax.dynamic_index_in_dim(stash, slot, keepdims=False)
+        stash = jax.lax.dynamic_update_index_in_dim(
+            stash, jnp.where(valid_f, inp, cur), slot, 0)
+        held_f = send_forward_recv_forward(out, axis_name)
+
+        # -- backward unit: dgrad ONLY on the critical path --------------
+        m_b = i - delay + rank
+        valid_b = (m_b >= 0) & (m_b < n_microbatches)
+        m_bc = jnp.clip(m_b, 0, n_microbatches - 1)
+        in_b = slice_mb(m_bc)
+        inp_b = jax.lax.dynamic_index_in_dim(
+            stash, m_bc % stash_slots, keepdims=False)
+        out_b, pull_x = dgrad_vjp(stage_fn, params["stage"], inp_b)
+
+        loss_val, dhead, seed = _head_seed(
+            loss_fn, is_last & valid_b, params["head"], out_b, in_b)
+
+        g_out = jnp.where(is_last, seed, held_b)
+        dinp = pull_x(g_out)[0]
+
+        dembed = _embed_pullback(
+            embed_fn, is_first & valid_b, params["embed"], in_b,
+            dinp.astype(h_dtype))
+
+        # -- wgrad placement (the knob) ----------------------------------
+        dstage = None
+        w_valid = None
+        if eager:
+            # exact 1F1B placement: wgrad at its dgrad tick
+            dstage, w_valid = wgrad(
+                stage_fn, params["stage"], inp_b, g_out), valid_b
+        if wstash is not None:
+            # the incoming pair and the entry it would evict share slot
+            # m_bc % K ((m_b - K) % K == m_b % K): ONE read serves both
+            # the bounded-mode flush and the masked push fallback, and
+            # it must happen before the update overwrites the slot
+            w_slot = m_bc % K
+            old_in = jax.lax.dynamic_index_in_dim(
+                wstash[0], w_slot, keepdims=False)
+            old_ct = jax.lax.dynamic_index_in_dim(
+                wstash[1], w_slot, keepdims=False)
+            if in_tick_wgrad:
+                dstage = wgrad(stage_fn, params["stage"], old_in, old_ct)
+                w_valid = valid_b & (m_b >= K)
+            wstash = (
+                jax.lax.dynamic_update_index_in_dim(
+                    wstash[0], jnp.where(valid_b, inp_b, old_in),
+                    w_slot, 0),
+                jax.lax.dynamic_update_index_in_dim(
+                    wstash[1], jnp.where(valid_b, g_out, old_ct),
+                    w_slot, 0))
+
+        grads = {
+            "embed": jax.tree.map(
+                lambda a, d: a + jnp.where(valid_b & is_first, d, 0),
+                grads["embed"], dembed),
+            "stage": grads["stage"] if dstage is None else jax.tree.map(
+                lambda a, d: a + jnp.where(w_valid, d, 0),
+                grads["stage"], dstage),
+            "head": jax.tree.map(
+                lambda a, d: a + jnp.where(valid_b, d, 0),
+                grads["head"], dhead),
+        }
+        loss_sum = loss_sum + loss_val    # zero off the last rank
+        held_b = send_backward_recv_backward(dinp, axis_name)
+        marks = {"f": valid_f, "b": valid_b}
+        if w_valid is not None:
+            marks["w"] = w_valid
+        _mon.traced_tick_marks("pipeline/zb1", i, rank, **marks)
+
+        return (held_f, held_b, stash, wstash, grads, loss_sum), None
+
+    (_, _, _, wstash, grads, loss_sum), _ = jax.lax.scan(
+        tick, init, jnp.arange(total_ticks))
+
+    if K:
+        # -- deferred-wgrad flush: the bubble ticks' wgrad work, run
+        # densely — every step is a valid unit (microbatches
+        # nmb-K .. nmb-1; every rank owns exactly nmb backward units,
+        # so every stashed pair is real)
+        def flush(stage_grads, f_idx):
+            m = n_microbatches - K + f_idx
+            w_slot = m % K
+            w_in = jax.lax.dynamic_index_in_dim(
+                wstash[0], w_slot, keepdims=False)
+            w_ct = jax.lax.dynamic_index_in_dim(
+                wstash[1], w_slot, keepdims=False)
+            d = wgrad(stage_fn, params["stage"], w_in, w_ct)
+            _mon.traced_tick_marks("pipeline/zb1", total_ticks + f_idx,
+                                   rank, w=True)
+            return jax.tree.map(jnp.add, stage_grads, d), None
+
+        stage_grads, _ = jax.lax.scan(
+            flush, grads["stage"], jnp.arange(K))
+        grads = dict(grads, stage=stage_grads)
+    return loss_sum, grads
+
+
+def forward_backward_pipelining_zb_interleaved(
+        stage_fn: Callable, loss_mb: Callable, chunk_params, x,
+        n_microbatches: int, n_chunks: Optional[int] = None,
+        axis_name: str = ps.PIPELINE_AXIS,
+        wgrad_stash: Optional[int] = None, remat_policy=None):
+    """Headless interleaved zero-bubble (stage stack only) — the vpp
+    analog of ``forward_backward_pipelining_zb``, same relationship as
+    the 1F1B pair. ``chunk_params`` leaves stacked [n_chunks, ...];
+    ``wgrad_stash`` supports only full deferral (``None``) and eager
+    (``0``) on the interleaved variant."""
+    if n_chunks is None:
+        leaf = jax.tree_util.tree_leaves(chunk_params)[0]
+        n_chunks = leaf.shape[0]
+    loss, grads = forward_backward_pipelining_zb_interleaved_model(
+        lambda _, x_mb: x_mb,
+        stage_fn,
+        lambda _, h, __: loss_mb(h),
+        {"embed": {}, "stage": chunk_params, "head": {}},
+        x, n_microbatches, n_chunks, axis_name,
+        wgrad_stash=wgrad_stash, remat_policy=remat_policy)
+    return loss, grads["stage"]
+
+
+def forward_backward_pipelining_zb_interleaved_model(
+        embed_fn: Callable, stage_fn: Callable, loss_fn: Callable,
+        params, inputs, n_microbatches: int, n_chunks: int,
+        axis_name: str = ps.PIPELINE_AXIS,
+        debug_axis_probe: Optional[bool] = None,
+        wgrad_stash: Optional[int] = None, remat_policy=None):
+    """Interleaved (vpp) zero-bubble: the split-backward treatment of
+    ``forward_backward_pipelining_1f1b_interleaved_model``.
+
+    The tick grid, both ring transports, the backward enumeration
+    (exact time-reversal, chunks descending within each group), the
+    embed/head conds, and every contract — including **no pipeline-axis
+    collectives in embed_fn/loss_fn** — are the interleaved 1F1B's
+    unchanged; only the backward unit is dgrad-only
+    (``backward_split.dgrad_vjp``) with the wgrad deferred. The stash
+    holds one ``(activation, cotangent)`` pair per executed (chunk,
+    microbatch) unit — ``[V, nmb]`` slots — and the post-scan flush
+    runs all ``V·nmb`` wgrads densely, selecting chunk params per
+    entry and scattering into the ``[V, ...]`` grad leaves exactly as
+    the tick body does.
+
+    ``wgrad_stash``: only ``None`` (full deferral) and ``0`` (eager =
+    exact interleaved-1F1B placement) — the bounded middle exists only
+    on the non-interleaved schedule (a bounded FIFO over the
+    chunk-major backward order buys little once V > 1 and complicates
+    the slot arithmetic; raise rather than silently reinterpret).
+    Executed unit-slots per rank: ``2·T + V·nmb`` (T = total ticks) vs
+    the interleaved 1F1B's ``3·T`` — the same strict idle-fraction
+    reduction as the plain schedule.
+    """
+    if wgrad_stash not in (None, 0):
+        raise ValueError(
+            "the interleaved zero-bubble schedule supports only full "
+            "deferral (wgrad_stash=None) or eager flush (0); got "
+            f"{wgrad_stash!r}")
+    n_microbatches = resolve_num_microbatches(n_microbatches)
+    stage_fn = with_remat_policy(stage_fn, remat_policy)
+    n_stages = _axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    V = n_chunks
+    P = n_stages
+    D = V * P
+    eager = wgrad_stash == 0
+    lead = {leaf.shape[0]
+            for leaf in jax.tree_util.tree_leaves(params["stage"])}
+    if lead != {V}:
+        raise ValueError(
+            f"params['stage'] leaves must be stacked [n_chunks={V}, ...]; "
+            f"got leading dims {sorted(lead)}")
+    if n_microbatches % n_stages != 0:
+        raise ValueError(
+            f"interleaved zero-bubble needs n_microbatches "
+            f"({n_microbatches}) divisible by pipeline size ({n_stages})")
+    is_last = rank == n_stages - 1
+    is_first = rank == 0
+    total_ticks = ((n_microbatches - 1) // P) * D + (n_microbatches - 1) % P \
+        + 2 * (D - 1) + 1
+    n_units = V * n_microbatches
+    _mon.pipeline_schedule(
+        "interleaved_zb1", n_stages, n_microbatches, total_ticks,
+        useful_slots=3 * n_units,
+        total_slots=(3 if eager else 2) * total_ticks
+        + (0 if eager else n_units))
+    stash_slots = 2 * P + 1
+
+    slice_mb = _mb_slicer(inputs)
+
+    def chunk_of(tree, c):
+        return jax.tree.map(
+            lambda p: jax.lax.dynamic_index_in_dim(p, c, 0, keepdims=False),
+            tree)
+
+    h_shape, h_dtype = _probe_h(embed_fn, params["embed"], slice_mb)
+
+    if _axis_probe_enabled(debug_axis_probe):
+        _probe_no_pipeline_collectives(
+            "embed_fn", embed_fn, (params["embed"], slice_mb(0)),
+            axis_name)
+        _probe_no_pipeline_collectives(
+            "loss_fn", loss_fn,
+            (params["head"], jnp.zeros(h_shape, h_dtype), slice_mb(0)),
+            axis_name)
+
+    init = (
+        jnp.zeros(h_shape, h_dtype),                          # held_f
+        jnp.zeros(h_shape, h_dtype),                          # held_b
+        jnp.zeros((V, stash_slots) + h_shape, h_dtype),       # input stash
+        # deferred-wgrad stash: one pair per (chunk, microbatch) unit
+        None if eager else (
+            jnp.zeros((V, n_microbatches) + h_shape, h_dtype),
+            jnp.zeros((V, n_microbatches) + h_shape, h_dtype)),
+        jax.tree.map(jnp.zeros_like, params),                 # grad acc
+        jnp.zeros((), jnp.float32),                           # loss sum
+    )
+
+    def scatter_chunk(c, pred, acc, d):
+        cur_c = jax.lax.dynamic_index_in_dim(acc, c, 0, keepdims=False)
+        upd = cur_c + jnp.where(pred, d, 0)
+        return jax.lax.dynamic_update_index_in_dim(acc, upd, c, 0)
+
+    def tick(carry, i):
+        held_f, held_b, stash, wstash, grads, loss_sum = carry
+        _mon.traced_tick("pipeline/interleaved_zb1/tick", i)
+
+        # -- forward unit (interleaved enumeration, unchanged) -----------
+        u = i - rank
+        valid_f = (u >= 0) & (u < n_units)
+        uc = jnp.clip(u, 0, n_units - 1)
+        grp, rem = uc // D, uc % D
+        c_f = rem // P
+        m_f = grp * P + rem % P
+        pf = chunk_of(params["stage"], c_f)
+        use_inject = valid_f & (c_f == 0) & is_first
+        inject = _embed_inject(embed_fn, use_inject, params["embed"],
+                               slice_mb(m_f), h_shape, h_dtype)
+        inp = jnp.where(use_inject, inject, held_f)
+        out = stage_fn(pf, inp)
+        slot = m_f % stash_slots
+        cur = jax.lax.dynamic_index_in_dim(
+            jax.lax.dynamic_index_in_dim(stash, c_f, 0, keepdims=False),
+            slot, 0, keepdims=False)
+        new_slot = jnp.where(valid_f, inp, cur)
+        stash = jax.lax.dynamic_update_slice(
+            stash, new_slot[None, None], (c_f, slot) + (0,) * len(h_shape))
+        held_f = ring_shift(out, axis_name, wrap=True)
+
+        # -- backward unit: dgrad only (time-reversed enumeration) -------
+        w = i - 2 * (D - 1) + rank
+        l = w % P
+        z = (w - l) // P
+        q = (z + V - 1) // V
+        c_b = q * V - z
+        m_b = q * P + l
+        valid_b = (q >= 0) & (m_b < n_microbatches)
+        m_bc = jnp.clip(m_b, 0, n_microbatches - 1)
+        c_bc = jnp.clip(c_b, 0, V - 1)
+        in_b = slice_mb(m_bc)
+        inp_b = jax.lax.dynamic_index_in_dim(
+            jax.lax.dynamic_index_in_dim(stash, c_bc, 0, keepdims=False),
+            m_bc % stash_slots, 0, keepdims=False)
+        pb = chunk_of(params["stage"], c_bc)
+        out_b, pull_x = dgrad_vjp(stage_fn, pb, inp_b)
+
+        seed_here = is_last & valid_b & (c_bc == V - 1)
+        loss_val, dhead, seed = _head_seed(
+            loss_fn, seed_here, params["head"], out_b, in_b)
+
+        g_out = jnp.where(seed_here, seed, held_b)
+        dinp = pull_x(g_out)[0]
+
+        dembed = _embed_pullback(
+            embed_fn, is_first & valid_b & (c_bc == 0), params["embed"],
+            in_b, dinp.astype(h_dtype))
+
+        if eager:
+            dchunk = wgrad(stage_fn, pb, inp_b, g_out)
+            stage_grads = jax.tree.map(
+                lambda a, d: scatter_chunk(c_bc, valid_b, a, d),
+                grads["stage"], dchunk)
+        else:
+            stage_grads = grads["stage"]
+            cur_in = jax.lax.dynamic_index_in_dim(
+                jax.lax.dynamic_index_in_dim(
+                    wstash[0], c_bc, 0, keepdims=False),
+                m_bc, 0, keepdims=False)
+            cur_ct = jax.lax.dynamic_index_in_dim(
+                jax.lax.dynamic_index_in_dim(
+                    wstash[1], c_bc, 0, keepdims=False),
+                m_bc, 0, keepdims=False)
+            idx = (c_bc, m_bc) + (0,) * len(h_shape)
+            wstash = (
+                jax.lax.dynamic_update_slice(
+                    wstash[0], jnp.where(valid_b, inp_b, cur_in)[None, None],
+                    idx),
+                jax.lax.dynamic_update_slice(
+                    wstash[1], jnp.where(valid_b, g_out, cur_ct)[None, None],
+                    idx))
+
+        grads = {
+            "embed": jax.tree.map(
+                lambda a, d: a + jnp.where(valid_b & is_first, d, 0),
+                grads["embed"], dembed),
+            "stage": stage_grads,
+            "head": jax.tree.map(
+                lambda a, d: a + jnp.where(valid_b, d, 0),
+                grads["head"], dhead),
+        }
+        loss_sum = loss_sum + loss_val
+        held_b = ring_shift(dinp, axis_name, reverse=True, wrap=True)
+        marks = {"f": valid_f, "b": valid_b}
+        if eager:
+            marks["w"] = valid_b
+        _mon.traced_tick_marks("pipeline/interleaved_zb1", i, rank,
+                               **marks)
+
+        return (held_f, held_b, stash, wstash, grads, loss_sum), None
+
+    (_, _, _, wstash, grads, loss_sum), _ = jax.lax.scan(
+        tick, init, jnp.arange(total_ticks))
+
+    if not eager:
+        # dense flush over every (chunk, microbatch) unit — all valid
+        def flush(stage_grads, f_idx):
+            c = f_idx // n_microbatches
+            m = f_idx % n_microbatches
+            w_in = jax.lax.dynamic_index_in_dim(
+                jax.lax.dynamic_index_in_dim(
+                    wstash[0], c, 0, keepdims=False), m, 0, keepdims=False)
+            w_ct = jax.lax.dynamic_index_in_dim(
+                jax.lax.dynamic_index_in_dim(
+                    wstash[1], c, 0, keepdims=False), m, 0, keepdims=False)
+            d = wgrad(stage_fn, chunk_of(params["stage"], c), w_in, w_ct)
+            _mon.traced_tick_marks("pipeline/interleaved_zb1",
+                                   total_ticks + f_idx, rank, w=True)
+            return jax.tree.map(
+                lambda a, dd: scatter_chunk(c, True, a, dd),
+                stage_grads, d), None
+
+        stage_grads, _ = jax.lax.scan(
+            flush, grads["stage"], jnp.arange(n_units))
+        grads = dict(grads, stage=stage_grads)
+    return loss_sum, grads
 
 
 def staged_group_scan(grad_of_group: Callable, params, xs,
@@ -689,7 +1215,8 @@ def pipeline_apply_interleaved(stage_fn: Callable, chunk_params, x,
                                n_microbatches: int, n_chunks: int,
                                axis_name: str = ps.PIPELINE_AXIS,
                                remat: bool = True,
-                               with_aux: bool = False):
+                               with_aux: bool = False,
+                               remat_policy=None):
     """Interleaved (virtual-pipeline) schedule over the pipeline axis.
 
     Each rank holds ``n_chunks`` (= vpp) model chunks stacked on the
@@ -734,7 +1261,7 @@ def pipeline_apply_interleaved(stage_fn: Callable, chunk_params, x,
     total_ticks = V * n_microbatches + n_stages - 1
     _mon.pipeline_schedule("interleaved", n_stages, n_microbatches,
                            total_ticks, useful_ticks=V * n_microbatches)
-    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    fn = _checkpointed(stage_fn, remat, remat_policy)
 
     h_shape = x.shape[1:]
     init_held = jnp.zeros(h_shape, x.dtype)
