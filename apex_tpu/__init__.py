@@ -17,6 +17,11 @@ idiomatically for TPU:
 - ``apex_tpu.parallel``  — data-parallel gradient synchronization and
   synchronized BatchNorm over ICI collectives on a GSPMD mesh
   (reference: ``apex/parallel/distributed.py:129``).
+- ``apex_tpu.zero``      — parameter-sharded (ZeRO-3/FSDP) training:
+  regex sharding rules, gather-behind-forward / reduce-scatter-behind-
+  backward, sharded fused Adam/LAMB with fp32 master shards under amp
+  O2, elastic (world-size-changing) checkpoint resharding
+  (reference: ``apex/contrib/optimizers/distributed_fused_adam.py``).
 - ``apex_tpu.transformer`` — Megatron-style tensor/pipeline/sequence/
   context parallel state and layers mapped to TPU mesh axes
   (reference: ``apex/transformer/parallel_state.py:53``).
@@ -44,6 +49,7 @@ from apex_tpu import rnn  # noqa: F401
 from apex_tpu import monitor  # noqa: F401
 from apex_tpu import pyprof  # noqa: F401
 from apex_tpu import checkpoint  # noqa: F401
+from apex_tpu import zero  # noqa: F401
 
 # heavier subpackages (transformer, contrib, models) import on demand:
 #   import apex_tpu.transformer / apex_tpu.contrib / apex_tpu.models

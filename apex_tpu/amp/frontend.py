@@ -142,6 +142,40 @@ class _AmpStash:
         self.already_patched = True
 
 
+def _wrap_zero(zero, model_list, opt_list, amp_model=None):
+    """Wrap the (single) model in a :class:`ZeroShardedModel` and point
+    each optimizer at it — ``zero.make_train_step`` defaults its model
+    argument from ``opt._zero_model`` (the scaler rides the usual
+    ``opt._amp_stash``)."""
+    from apex_tpu.zero import ZeroShardedModel
+    if len(model_list) != 1:
+        raise ValueError(
+            "initialize(zero=...) supports exactly one model (the "
+            f"sharded parameter tree belongs to one forward); got "
+            f"{len(model_list)}")
+    if isinstance(zero, ZeroShardedModel):
+        zm = zero
+        zm.apply_fn = model_list[0]
+    else:
+        kw = {} if zero is True else dict(zero)
+        zm = ZeroShardedModel(model_list[0], **kw)
+    if amp_model is not None:
+        # cast_params on the wrapper routes through the AmpModel's
+        # opt-level cast (shard paths == param paths, so the
+        # keep-fp32 predicates apply unchanged)
+        zm._amp_model = amp_model
+    for opt in opt_list:
+        ax = getattr(opt, "axis_name", None)
+        if ax is not None and ax != zm.axis_name:
+            raise ValueError(
+                f"initialize(zero=...): optimizer.axis_name={ax!r} does "
+                f"not match the zero axis {zm.axis_name!r} — a mismatch "
+                "silently degrades the shard update to world=1; construct "
+                f"the optimizer with axis_name={zm.axis_name!r}")
+        opt._zero_model = zm
+    return zm
+
+
 def initialize(
     models,
     optimizers=None,
@@ -161,6 +195,7 @@ def initialize(
     min_loss_scale: float | None = None,
     max_loss_scale: float = 2.0 ** 24,
     keep_fp32_predicate: Callable | None = None,
+    zero=None,
 ):
     """Initialize amp. Reference: ``amp.initialize`` ``apex/amp/frontend.py:195-358``.
 
@@ -171,6 +206,18 @@ def initialize(
     Returns ``(models, optimizers)`` with the same list-ness as the inputs
     (frontend.py:342-358).
 
+    ``zero=`` composes ZeRO-3 parameter sharding with the opt level
+    (``apex_tpu.zero``; most useful under O2, where the fp32 master
+    lives as the optimizer's shard): pass ``True`` (default rules), a
+    kwargs dict for :class:`apex_tpu.zero.ZeroShardedModel` (``rules``,
+    ``axis_name``, ``min_shard_size``, ``overlap_comm``), or a
+    pre-built ``ZeroShardedModel``. The returned model is then that
+    wrapper — ``model(shards, *args)`` materializes transiently and
+    runs the amp-cast forward — and each optimizer learns the wrapper
+    (``opt._zero_model``), which ``zero.make_train_step`` uses as its
+    default model (the armed scaler rides ``opt._amp_stash`` as usual).
+    Single model only (the sharded tree belongs to one forward).
+
     ``enabled=False`` renders amp inert (``apex/amp/frontend.py:195-215``):
     no casting, no scaler arming, and ``amp.scale_loss`` yields the loss
     unscaled — code written against the amp API runs at full precision
@@ -178,8 +225,11 @@ def initialize(
     convention as the enabled path (``fn(params, *args)``): a flax
     Module input returns its ``.apply`` rather than the unbound module,
     so ``m = initialize(module, ..., enabled=flag)`` is callable either
-    way. Optimizers are returned untouched. ``enabled`` sits third
-    positionally, exactly like the reference.
+    way. ``zero=`` also survives disablement: the model still comes back
+    as a :class:`~apex_tpu.zero.ZeroShardedModel` (full precision — no
+    cast, no scaler arming) so FSDP code runs unchanged. Optimizers are
+    otherwise returned untouched. ``enabled`` sits third positionally,
+    exactly like the reference.
     """
     _amp_state.verbosity = verbosity
     if isinstance(enabled, str):
@@ -200,6 +250,20 @@ def initialize(
             out_models = type(models)(_plain(m) for m in models)
         else:
             out_models = _plain(models)
+        if zero is not None and zero is not False:
+            # amp is inert, but the zero= surface must survive: callers
+            # are written against ZeroShardedModel (shard/materialize/
+            # make_train_step), so wrap the plain apply with no amp cast
+            # attached — full-precision FSDP, same calling convention.
+            model_list = (list(out_models)
+                          if isinstance(out_models, (list, tuple))
+                          else [out_models])
+            opt_list = (list(optimizers)
+                        if isinstance(optimizers, (list, tuple))
+                        else [optimizers] if optimizers is not None else [])
+            zm = _wrap_zero(zero, model_list, opt_list)
+            out_models = (type(models)([zm])
+                          if isinstance(models, (list, tuple)) else zm)
         if optimizers is None:
             return out_models
         return out_models, optimizers
@@ -271,6 +335,10 @@ def initialize(
         opt._amp_stash = _AmpStash(properties, scalers)
         if hasattr(opt, "configure_amp"):
             opt.configure_amp(properties, scalers[0])
+
+    if zero is not None and zero is not False:
+        amp_models = [_wrap_zero(zero, amp_models, opt_list,
+                                 amp_model=amp_models[0])]
 
     out_models = amp_models if models_was_list else amp_models[0]
     if optimizers is None:

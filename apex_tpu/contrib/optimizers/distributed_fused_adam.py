@@ -7,12 +7,17 @@ sharded m/v/master state), and the new parameters are all-gathered back
 (optionally e5m2-compressed). Overlap is pipelined per block during
 backward.
 
-TPU design: one ``psum_scatter`` + sharded fused update + one
-``all_gather`` inside the jitted step — XLA overlaps the collectives with
-surrounding compute (the hand-built per-block pipelining of the reference
-is the scheduler's job here). Optional ``compress_allgather`` casts the
-gathered params to float8_e5m2 (the reference's e5m2 trick) — master
-state stays exact, so compression only quantizes the *broadcast* copy.
+Since the ``apex_tpu.zero`` subsystem landed, this class IS
+``ZeroOptimizer(kind="adam", shard_params=False)`` — the ZeRO-1/2 tier:
+optimizer state sharded, parameters replicated, one ``psum_scatter`` +
+sharded fused update + one ``all_gather`` inside the jitted step (XLA
+overlaps the collectives with surrounding compute; ``overlap_comm=True``
+opts into the explicit ppermute rings instead). The update math and the
+accounted collectives are the shared ``zero/update.py`` /
+``zero/comm.py`` implementations — the same code ZeRO-3 runs on per-leaf
+shards — and ``compress_allgather`` rides
+``zero.comm.quantized_all_gather`` (the reference's e5m2 trick: master
+state stays exact, only the *broadcast* copy is quantized).
 
 Run ``init``/``apply`` inside ``shard_map`` over the shard axis. At
 world=1 it degrades to plain fused Adam.
@@ -20,130 +25,20 @@ world=1 it degrades to plain fused Adam.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple
-
-import jax
-import jax.numpy as jnp
-
-from apex_tpu.utils.flat import FlatBuffer
-from apex_tpu._compat import axis_size as _axis_size
+from apex_tpu.zero.optimizer import ZeroOptimizer
+from apex_tpu.zero.update import ShardedAdamState  # noqa: F401  (re-export)
 
 
-class ShardedAdamState(NamedTuple):
-    step: jax.Array
-    master_shard: jax.Array   # [total/world] fp32
-    m_shard: jax.Array
-    v_shard: jax.Array
-
-
-def _pad_to(x, mult):
-    pad = (-x.shape[0]) % mult
-    if pad:
-        x = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
-    return x
-
-
-class DistributedFusedAdam:
+class DistributedFusedAdam(ZeroOptimizer):
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
                  eps=1e-8, weight_decay=0.0, adam_w_mode=True,
                  gradient_average=True, axis_name: str = "data",
-                 compress_allgather: bool = False):
-        self.lr = lr
-        self.bias_correction = bias_correction
-        self.betas = betas
-        self.eps = eps
-        self.weight_decay = weight_decay
-        self.adam_w_mode = adam_w_mode
-        self.gradient_average = gradient_average
-        self.axis_name = axis_name
-        self.compress_allgather = compress_allgather
-        self._spec: FlatBuffer | None = None
-
-    def _world(self):
-        try:
-            return _axis_size(self.axis_name)
-        except NameError:
-            return 1
-
-    def init(self, params) -> ShardedAdamState:
-        self._spec = FlatBuffer.from_tree(params)
-        world = self._world()
-        flat = _pad_to(self._spec.pack(params, dtype=jnp.float32), world)
-        per = flat.shape[0] // world
-        if world > 1:
-            rank = jax.lax.axis_index(self.axis_name)
-            shard = jax.lax.dynamic_slice_in_dim(flat, rank * per, per)
-        else:
-            shard = flat
-        return ShardedAdamState(
-            step=jnp.asarray(0, jnp.int32),
-            master_shard=shard,
-            m_shard=jnp.zeros_like(shard),
-            v_shard=jnp.zeros_like(shard),
-        )
-
-    def gather_state(self, state: ShardedAdamState) -> ShardedAdamState:
-        """Topology-independent full state for checkpointing (inside
-        ``shard_map``); see ``apex_tpu.contrib.optimizers.zero_state``."""
-        from apex_tpu.contrib.optimizers.zero_state import gather_zero_state
-        return gather_zero_state(self, state)
-
-    def shard_state(self, full_state: ShardedAdamState,
-                    params=None) -> ShardedAdamState:
-        """Local shard of a gathered state under the CURRENT mesh — the
-        dp=8 -> dp=4 resume path (``distributed_fused_lamb.py:139``)."""
-        from apex_tpu.contrib.optimizers.zero_state import shard_zero_state
-        return shard_zero_state(self, full_state, params)
-
-    def apply(self, state: ShardedAdamState, params, grads, skip=None, lr=None):
-        """One sharded step; returns (new_params, new_state)."""
-        if self._spec is None:
-            self._spec = FlatBuffer.from_tree(params)
-        spec = self._spec
-        world = self._world()
-        lr = jnp.asarray(self.lr if lr is None else lr, jnp.float32)
-        if skip is None:
-            skip = jnp.asarray(False)
-
-        flat_g = _pad_to(spec.pack(grads, dtype=jnp.float32), world)
-        if world > 1:
-            # reduce_scatter: each rank receives the summed shard it owns
-            # (distributed_fused_adam.py:409 _pipeline_block_reductions)
-            g_shard = jax.lax.psum_scatter(flat_g, self.axis_name, tiled=True)
-            if self.gradient_average:
-                g_shard = g_shard / world
-        else:
-            g_shard = flat_g
-
-        def _do(state=state, g=g_shard, lr=lr):
-            b1, b2 = self.betas
-            step = state.step + 1
-            p = state.master_shard
-            if not self.adam_w_mode and self.weight_decay:
-                g = g + self.weight_decay * p
-            m = b1 * state.m_shard + (1 - b1) * g
-            v = b2 * state.v_shard + (1 - b2) * g * g
-            if self.bias_correction:
-                sf = step.astype(jnp.float32)
-                mhat = m / (1 - jnp.power(b1, sf))
-                vhat = v / (1 - jnp.power(b2, sf))
-            else:
-                mhat, vhat = m, v
-            upd = mhat / (jnp.sqrt(vhat) + self.eps)
-            if self.adam_w_mode and self.weight_decay:
-                upd = upd + self.weight_decay * p
-            return ShardedAdamState(step, p - lr * upd, m, v)
-
-        new_state = jax.lax.cond(skip, lambda: state, _do)
-
-        # all_gather the fresh params (distributed_fused_adam.py:477),
-        # optionally through the e5m2 compressed path
-        shard_out = new_state.master_shard
-        if self.compress_allgather:
-            shard_out = shard_out.astype(jnp.float8_e5m2)
-        if world > 1:
-            flat_new = jax.lax.all_gather(shard_out, self.axis_name, tiled=True)
-        else:
-            flat_new = shard_out
-        flat_new = flat_new.astype(jnp.float32)[:spec.total]
-        return spec.unpack(flat_new), new_state
+                 compress_allgather: bool = False,
+                 overlap_comm: bool = False):
+        super().__init__(
+            lr, kind="adam", shard_params=False,
+            bias_correction=bias_correction, betas=betas, eps=eps,
+            weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+            gradient_average=gradient_average, axis_name=axis_name,
+            compress_allgather=compress_allgather,
+            overlap_comm=overlap_comm)

@@ -10,201 +10,44 @@ per-tensor update norms, sharded weight update, allgather of new params
 TPU: the same dataflow in one jitted region: psum_scatter grads → global
 norm (psum of shard partials) → sharded Adam-style update term →
 per-tensor norms + psum → trust-ratio-scaled sharded update → all_gather
-params.
+params. Since ``apex_tpu.zero`` landed this class IS
+``ZeroOptimizer(kind="lamb", shard_params=False)``; the layout-specific
+trust-ratio machinery below is documented here and implemented on the
+shared base.
 
 Per-tensor reductions exploit that each leaf occupies a CONTIGUOUS range
 of the flat buffer, so every leaf∩shard intersection is a contiguous
 (dynamic) range: shard-local per-leaf sums are masked static-length
-window reductions (exact — see ``_range_sums``), and the per-position
-trust ratio is a piecewise-constant ramp built by one tiny scatter +
-cumsum — no ``segment_sum`` scatter and no flat-sized gather, both of
-which lower poorly on TPU (a BERT-base LAMB step went ~100x slower than
-its matmuls through them).
+window reductions (exact — see ``ZeroOptimizer._range_sums``), and the
+per-position trust ratio is a piecewise-constant ramp built by one tiny
+scatter + cumsum — no ``segment_sum`` scatter and no flat-sized gather,
+both of which lower poorly on TPU (a BERT-base LAMB step went ~100x
+slower than its matmuls through them). (The ZeRO-3 tier's per-leaf
+layout makes every range STATIC and skips all of this — see
+``zero/optimizer.py``.)
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple
-
-import numpy as np
-import jax
-import jax.numpy as jnp
-
-from apex_tpu.utils.flat import FlatBuffer
-from apex_tpu._compat import axis_size as _axis_size
+from apex_tpu.zero.optimizer import ZeroOptimizer
+from apex_tpu.zero.update import ShardedLambState  # noqa: F401  (re-export)
 
 
-class ShardedLambState(NamedTuple):
-    step: jax.Array
-    master_shard: jax.Array
-    m_shard: jax.Array
-    v_shard: jax.Array
-
-
-class DistributedFusedLAMB:
+class DistributedFusedLAMB(ZeroOptimizer):
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
                  eps=1e-6, weight_decay=0.01, max_grad_norm=1.0,
                  adam_w_mode=True, grad_averaging=True, use_nvlamb=False,
-                 axis_name: str = "data"):
-        self.lr = lr
-        self.bias_correction = bias_correction
-        self.betas = betas
-        self.eps = eps
-        self.weight_decay = weight_decay
-        self.max_grad_norm = max_grad_norm
-        self.adam_w_mode = adam_w_mode
-        self.grad_averaging = grad_averaging
-        self.use_nvlamb = use_nvlamb
-        self.axis_name = axis_name
-        self._spec: FlatBuffer | None = None
+                 axis_name: str = "data", overlap_comm: bool = False):
+        super().__init__(
+            lr, kind="lamb", shard_params=False,
+            bias_correction=bias_correction, betas=betas, eps=eps,
+            weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+            gradient_average=grad_averaging, max_grad_norm=max_grad_norm,
+            use_nvlamb=use_nvlamb, axis_name=axis_name,
+            overlap_comm=overlap_comm)
 
-    def _world(self):
-        try:
-            return _axis_size(self.axis_name)
-        except NameError:
-            return 1
-
-    def _prepare(self, params):
-        self._spec = FlatBuffer.from_tree(params)
-
-    def _padded(self, flat, world):
-        pad = (-flat.shape[0]) % world
-        if pad:
-            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-        return flat
-
-    def _leaf_starts_in_shard(self, base, per):
-        """Per-leaf clipped start positions in shard coordinates (the
-        piecewise trust-ratio ramp's scatter indices)."""
-        offs = jnp.asarray(self._spec.offsets, jnp.int32)
-        return jnp.clip(offs - base, 0, per)
-
-    def _range_sums(self, x, base, per):
-        """Per-leaf sums of the leaf∩shard ranges, computed EXACTLY.
-
-        Each leaf intersects the shard in a contiguous range of length
-        ≤ min(leaf_size, per) — a *static* bound, so a dynamic-start
-        static-length window plus an in-window mask gives a plain masked
-        reduction per leaf. (A cumsum-difference formulation cancels
-        catastrophically in f32: a 256-element leaf after a 2M-element
-        prefix summed to exactly 0.)
-        """
-        sums = []
-        for off, size in zip(self._spec.offsets, self._spec.sizes):
-            L = min(size, per)
-            s = jnp.clip(off - base, 0, per)          # dynamic, in-shard
-            e = jnp.clip(off + size - base, 0, per)
-            w = jnp.clip(s, 0, per - L)               # window fits: static L
-            win = jax.lax.dynamic_slice_in_dim(x, w, L)
-            q = w + jnp.arange(L, dtype=jnp.int32)
-            mask = (q >= s) & (q < e)
-            sums.append(jnp.sum(jnp.where(mask, win, 0.0)))
-        return jnp.stack(sums)
-
-    @staticmethod
-    def _piecewise(values, starts, per):
-        """[per] vector equal to values[i] on leaf i's shard range —
-        a delta scatter (n tiny adds) + cumsum; positions past the last
-        leaf (alignment padding) carry the last value, harmless because
-        pad slots of p/update are zero."""
-        deltas = jnp.diff(values, prepend=jnp.zeros((1,), values.dtype))
-        d = jnp.zeros((per + 1,), values.dtype).at[starts].add(deltas)
-        return jnp.cumsum(d[:per])
-
-    def init(self, params) -> ShardedLambState:
-        self._prepare(params)
-        world = self._world()
-        flat = self._padded(self._spec.pack(params, dtype=jnp.float32), world)
-        per = flat.shape[0] // world
-        if world > 1:
-            rank = jax.lax.axis_index(self.axis_name)
-            shard = jax.lax.dynamic_slice_in_dim(flat, rank * per, per)
-        else:
-            shard = flat
-        return ShardedLambState(jnp.asarray(0, jnp.int32), shard,
-                                jnp.zeros_like(shard), jnp.zeros_like(shard))
-
-    def gather_state(self, state: ShardedLambState) -> ShardedLambState:
-        """Topology-independent full state for checkpointing (inside
-        ``shard_map``); see ``apex_tpu.contrib.optimizers.zero_state``."""
-        from apex_tpu.contrib.optimizers.zero_state import gather_zero_state
-        return gather_zero_state(self, state)
-
-    def shard_state(self, full_state: ShardedLambState,
-                    params=None) -> ShardedLambState:
-        """Local shard of a gathered state under the CURRENT mesh — the
-        resume path of ``_resume_from_checkpoint`` (lamb.py:139)."""
-        from apex_tpu.contrib.optimizers.zero_state import shard_zero_state
-        return shard_zero_state(self, full_state, params)
-
-    def apply(self, state: ShardedLambState, params, grads, skip=None, lr=None):
-        if self._spec is None:
-            self._prepare(params)
-        spec = self._spec
-        world = self._world()
-        lr = jnp.asarray(self.lr if lr is None else lr, jnp.float32)
-        if skip is None:
-            skip = jnp.asarray(False)
-        b1, b2 = self.betas
-
-        flat_g = self._padded(spec.pack(grads, dtype=jnp.float32), world)
-        per = flat_g.shape[0] // world
-        if world > 1:
-            g_shard = jax.lax.psum_scatter(flat_g, self.axis_name, tiled=True)
-            if self.grad_averaging:
-                g_shard = g_shard / world
-            rank = jax.lax.axis_index(self.axis_name)
-        else:
-            g_shard = flat_g
-            rank = 0
-
-        base = rank * per if world > 1 else 0
-        starts = self._leaf_starts_in_shard(base, per)
-
-        # global grad norm + clip (distributed_fused_lamb.py:665-699)
-        gsq = jnp.sum(g_shard * g_shard)
-        if world > 1:
-            gsq = jax.lax.psum(gsq, self.axis_name)
-        gnorm = jnp.sqrt(gsq)
-        if self.max_grad_norm and self.max_grad_norm > 0:
-            g_shard = g_shard / jnp.maximum(1.0, gnorm / self.max_grad_norm)
-
-        def _do(state=state, g=g_shard):
-            step = state.step + 1
-            p = state.master_shard
-            beta3 = (1 - b1) if self.grad_averaging else 1.0
-            if not self.adam_w_mode and self.weight_decay:
-                g = g + self.weight_decay * p
-            m = b1 * state.m_shard + beta3 * g
-            v = b2 * state.v_shard + (1 - b2) * g * g
-            if self.bias_correction:
-                sf = step.astype(jnp.float32)
-                mhat = m / (1 - jnp.power(b1, sf))
-                vhat = v / (1 - jnp.power(b2, sf))
-            else:
-                mhat, vhat = m, v
-            upd = mhat / (jnp.sqrt(vhat) + self.eps)
-            if self.adam_w_mode and self.weight_decay:
-                upd = upd + self.weight_decay * p
-
-            # per-tensor norms: shard-local contiguous-range sums +
-            # cross-shard psum (the allgather of update norms, :722-778)
-            w_sq = self._range_sums(p * p, base, per)
-            u_sq = self._range_sums(upd * upd, base, per)
-            if world > 1:
-                w_sq = jax.lax.psum(w_sq, self.axis_name)
-                u_sq = jax.lax.psum(u_sq, self.axis_name)
-            w_n = jnp.sqrt(w_sq)
-            u_n = jnp.sqrt(u_sq)
-            ratio = jnp.where((w_n > 0) & (u_n > 0), w_n / jnp.maximum(u_n, 1e-30), 1.0)
-            if not self.use_nvlamb and self.weight_decay == 0.0:
-                ratio = jnp.ones_like(ratio)
-            new_p = p - lr * self._piecewise(ratio, starts, per) * upd
-            return ShardedLambState(step, new_p, m, v)
-
-        new_state = jax.lax.cond(skip, lambda: state, _do)
-        if world > 1:
-            flat_new = jax.lax.all_gather(new_state.master_shard, self.axis_name, tiled=True)
-        else:
-            flat_new = new_state.master_shard
-        return spec.unpack(flat_new[:spec.total]), new_state
+    @property
+    def grad_averaging(self):
+        """apex's LAMB knob name (drives both the dp mean and beta3 —
+        the reference conflates them the same way)."""
+        return self.gradient_average

@@ -16,19 +16,26 @@ share the (step, master_shard, m_shard, v_shard) layout):
   to the new world size, slice the local shard. dp=8 state resumes on
   dp=4 (or any world) bit-exactly, because padding is zeros and the
   sharded update all-gathers identical params regardless of topology.
+
+The ZeRO-3 tier shards *parameters* too; its gather/reshard — the same
+moves per leaf, params and (step, master, m, v) alike — lives in
+``apex_tpu.zero.elastic`` and is re-exported here so the checkpoint
+entry points for every tier share one module. Collectives route through
+``zero/comm.py`` so the monitor's trace-time table accounts them.
 """
 
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 
-
-def _pad_to(x, mult):
-    pad = (-x.shape[0]) % mult
-    if pad:
-        x = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
-    return x
+from apex_tpu.zero import comm as _comm
+from apex_tpu.zero.core import pad_to_multiple as _pad_to
+from apex_tpu.zero.elastic import (  # noqa: F401  (tier-3 re-exports)
+    gather_zero3_params,
+    gather_zero3_state,
+    shard_zero3_params,
+    shard_zero3_state,
+)
 
 
 def gather_zero_state(opt, state):
@@ -38,11 +45,9 @@ def gather_zero_state(opt, state):
     if opt._spec is None:
         raise ValueError("optimizer has no flat spec yet — call init() "
                          "(or pass the state through apply once) first")
-    world = opt._world()
 
     def g(x):
-        full = (jax.lax.all_gather(x, opt.axis_name, tiled=True)
-                if world > 1 else x)
+        full = _comm.all_gather_flat(x, opt.axis_name)
         return full[:opt._spec.total]
 
     return type(state)(state.step, g(state.master_shard),

@@ -75,6 +75,8 @@ from apex_tpu.monitor import hooks as _mon
 __all__ = [
     "all_gather_matmul",
     "matmul_reduce_scatter",
+    "ring_all_gather",
+    "ring_psum_scatter",
     "bucket_partition",
     "bucketed_allreduce",
     "accumulate_gradients",
@@ -208,6 +210,78 @@ def _ring_weight_grad(travelling, resident, axis_name, block_dim: int,
         if k < tp - 1:
             chunk = jax.lax.ppermute(chunk, axis_name, perm)
     return dw
+
+
+# ---------------------------------------------------------------------------
+# bare ring collectives (no fused compute): the ZeRO-3 parameter
+# gather/scatter building blocks (``apex_tpu.zero``). Decomposing a
+# parameter all-gather into tp-1 ppermutes makes each hop an independent
+# eqn, so XLA's scheduler can run leaf A's remaining hops underneath the
+# layers that only consume leaf B — the per-leaf analog of the fused
+# collective-matmul rings above, for consumers that need the whole leaf
+# (embedding lookups, norms, bias adds) and therefore cannot fuse the
+# matmul into the ring.
+# ---------------------------------------------------------------------------
+
+
+def ring_all_gather(x, axis_name, gather_dim: int = 0):
+    """``all_gather(x, axis=gather_dim, tiled=True)`` as tp-1 ppermute
+    hops. Each arriving chunk is written straight into its origin rank's
+    block of the output, so the values (and the result) are *bitwise*
+    identical to the blocking all_gather — only the schedulability
+    changes."""
+    tp = _axis_size(axis_name)
+    if tp == 1:
+        return x
+    gather_dim = gather_dim % x.ndim
+    idx = jax.lax.axis_index(axis_name)
+    s_local = x.shape[gather_dim]
+    out_shape = list(x.shape)
+    out_shape[gather_dim] = s_local * tp
+    y = jnp.zeros(tuple(out_shape), x.dtype)
+    perm = _ring_perm(tp)
+    _account_ring(axis_name, x, tp - 1)
+    chunk = x
+    for k in range(tp):
+        src = (idx - k) % tp
+        y = jax.lax.dynamic_update_slice_in_dim(
+            y, chunk, src * s_local, axis=gather_dim)
+        if k < tp - 1:
+            chunk = jax.lax.ppermute(chunk, axis_name, perm)
+    return y
+
+
+def ring_psum_scatter(x, axis_name, scatter_dim: int = 0):
+    """``psum_scatter(x, scatter_dimension=scatter_dim, tiled=True)`` as
+    a travelling partial-sum accumulator: at step t rank i slices the
+    block destined for rank ``i - t - 1`` and adds it to the arriving
+    accumulator; after tp-1 hops each rank holds its own fully-reduced
+    block. The cross-rank additions are reassociated relative to the
+    fused collective, so parity is dtype-tolerance (fp32 ~1e-6), same
+    as :func:`matmul_reduce_scatter`."""
+    tp = _axis_size(axis_name)
+    if tp == 1:
+        return x
+    scatter_dim = scatter_dim % x.ndim
+    s_full = x.shape[scatter_dim]
+    if s_full % tp != 0:
+        raise ValueError(
+            f"ring_psum_scatter: dim {scatter_dim} of size {s_full} is "
+            f"not divisible by axis '{axis_name}' size {tp}")
+    idx = jax.lax.axis_index(axis_name)
+    s_local = s_full // tp
+    perm = _ring_perm(tp)
+    acc = None
+    for t in range(tp):
+        b = (idx - t - 1) % tp
+        blk = jax.lax.dynamic_slice_in_dim(
+            x, b * s_local, s_local, axis=scatter_dim)
+        if acc is None:
+            acc = blk
+        else:
+            acc = jax.lax.ppermute(acc, axis_name, perm) + blk
+    _account_ring(axis_name, acc, tp - 1)
+    return acc
 
 
 # ---------------------------------------------------------------------------

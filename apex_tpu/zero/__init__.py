@@ -1,0 +1,52 @@
+"""apex_tpu.zero — parameter-sharded (ZeRO-3/FSDP) training.
+
+The sharded-optimizer family behind one subsystem (ROADMAP item 1):
+
+- :mod:`~apex_tpu.zero.rules`      — regex rule table: param path ->
+  shard/replicate, with a small-leaf size threshold.
+- :mod:`~apex_tpu.zero.core`       — :class:`ZeroSpec`,
+  :func:`zero_shard`, :func:`zero_gather` (all-gather hidden behind the
+  forward, conjugate reduce-scatter behind the backward — ``custom_vjp``),
+  :class:`ZeroShardedModel`.
+- :mod:`~apex_tpu.zero.optimizer`  — :class:`ZeroOptimizer`: ZeRO-1/2
+  (``shard_params=False``, the ``contrib.optimizers`` configuration) and
+  ZeRO-3 (``shard_params=True``) on shared update math and accounted
+  collectives.
+- :mod:`~apex_tpu.zero.elastic`    — topology-independent gather /
+  reshard of tier-3 params + state (dp=8 saves, dp=4 resumes,
+  bit-exactly) for ``apex_tpu.checkpoint``.
+- :mod:`~apex_tpu.zero.step`       — :func:`make_train_step`: the amp
+  O2 + LossScaler overflow/skip composition over shards.
+
+Imports here do no jax work (APX001 discipline).
+"""
+
+from apex_tpu.zero.rules import (  # noqa: F401
+    DEFAULT_MIN_SHARD_SIZE,
+    DEFAULT_RULES,
+    REPLICATE,
+    SHARD,
+    match_zero_rules,
+)
+from apex_tpu.zero.core import (  # noqa: F401
+    ZeroShardedModel,
+    ZeroSpec,
+    build_spec,
+    params_resident_bytes,
+    zero_gather,
+    zero_shard,
+)
+from apex_tpu.zero.optimizer import (  # noqa: F401
+    ShardedAdamState,
+    ShardedLambState,
+    Zero3State,
+    ZeroOptimizer,
+)
+from apex_tpu.zero.elastic import (  # noqa: F401
+    gather_zero3_params,
+    gather_zero3_state,
+    shard_zero3_params,
+    shard_zero3_state,
+)
+from apex_tpu.zero.step import make_train_step  # noqa: F401
+from apex_tpu.zero import comm  # noqa: F401

@@ -1,0 +1,93 @@
+"""ZeRO collective plumbing: accounted flat-shard gather/scatter with a
+ring (overlapped) opt-in, plus the quantized-broadcast helper.
+
+Every sharded-optimizer data movement in the package funnels through
+these four functions, so the monitor's trace-time collective table sees
+the ZeRO traffic the same way it sees the amp/parallel/transformer
+paths, and the ring decomposition is ONE switch instead of a per-call
+reimplementation:
+
+- :func:`all_gather_flat` / :func:`reduce_scatter_flat` — the blocking
+  forms are the exact ``jax.lax`` collectives (``tiled=True``), so
+  ``overlap_comm=False`` programs are byte-identical to hand-written
+  gather/scatter jaxprs (asserted in ``tests/test_zero.py``);
+  ``overlap_comm=True`` swaps in the ppermute rings of
+  ``parallel/overlap.py`` (``ring_all_gather`` bitwise-equal,
+  ``ring_psum_scatter`` dtype-tolerance — the reassociated sum).
+- :func:`psum_flat` — accounted psum for replicated-leaf gradients.
+- :func:`quantized_all_gather` — apex's e5m2 compressed param broadcast
+  (``apex/contrib/optimizers/distributed_fused_adam.py:477``): cast the
+  shard to a narrow wire dtype, gather, cast back. Master state stays
+  exact; only the broadcast copy is quantized. Wire bytes are accounted
+  at the narrow dtype — that is the point of the knob.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu._compat import axis_size as _axis_size
+from apex_tpu.monitor import hooks as _mon
+
+
+def _account(op: str, axis_name: str, x) -> None:
+    if _mon.traced_enabled():
+        _mon.collective(op, axis_name, x)
+
+
+def _world_of(axis_name: str) -> int:
+    """Bound axis size, or 1 when the axis does not exist (outside
+    ``shard_map`` — the optimizers' world=1 degradation)."""
+    try:
+        return _axis_size(axis_name)
+    except NameError:
+        return 1
+
+
+def all_gather_flat(shard, axis_name: str, *, overlap_comm: bool = False):
+    """Full flat buffer from this rank's shard (``tiled=True``
+    semantics: ``[per] -> [world * per]``). Identity at world=1."""
+    if _world_of(axis_name) == 1:
+        return shard
+    if overlap_comm:
+        from apex_tpu.parallel.overlap import ring_all_gather
+        return ring_all_gather(shard, axis_name, 0)   # accounts ppermutes
+    _account("all_gather", axis_name, shard)
+    return jax.lax.all_gather(shard, axis_name, tiled=True)
+
+
+def reduce_scatter_flat(flat, axis_name: str, *, overlap_comm: bool = False):
+    """Summed local shard from a full flat buffer (``[world * per] ->
+    [per]``, rank i receiving the cross-rank sum of block i). Identity
+    at world=1."""
+    if _world_of(axis_name) == 1:
+        return flat
+    if overlap_comm:
+        from apex_tpu.parallel.overlap import ring_psum_scatter
+        return ring_psum_scatter(flat, axis_name, 0)  # accounts ppermutes
+    _account("psum_scatter", axis_name, flat)
+    return jax.lax.psum_scatter(flat, axis_name, tiled=True)
+
+
+def psum_flat(x, axis_name: str):
+    """Accounted ``psum`` (replicated-leaf gradients, norm partials).
+    Identity at world=1."""
+    if _world_of(axis_name) == 1:
+        return x
+    _account("psum", axis_name, x)
+    return jax.lax.psum(x, axis_name)
+
+
+def quantized_all_gather(shard, axis_name: str, *,
+                         wire_dtype=jnp.float8_e5m2, out_dtype=None,
+                         overlap_comm: bool = False):
+    """All-gather ``shard`` through a narrow wire dtype.
+
+    The returned buffer is ``out_dtype`` (default: the shard's own
+    dtype); every block — including the local one, for cross-rank
+    bitwise consistency — has round-tripped through ``wire_dtype``."""
+    out_dtype = shard.dtype if out_dtype is None else out_dtype
+    wire = shard.astype(wire_dtype)
+    return all_gather_flat(wire, axis_name,
+                           overlap_comm=overlap_comm).astype(out_dtype)

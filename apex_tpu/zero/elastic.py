@@ -1,0 +1,67 @@
+"""Elastic resharding for ZeRO-3: parameter AND optimizer shards to a
+topology-independent form and back, under a possibly different world.
+
+This extends ``contrib/optimizers/zero_state.py`` (the tier-1/2 flat-
+buffer gather/reshard) to the tier-3 per-leaf layout. The invariant is
+the same: the GATHERED form never contains padding — each leaf is
+all-gathered, unpadded to its logical size, and reshaped to the
+original parameter shape — so resharding under a new world size only
+re-pads with zeros and re-slices. dp=8 state therefore resumes on dp=4
+(or any world) bit-exactly: all-gather moves bits, padding is zeros,
+and the update math never reads across leaf boundaries.
+
+The gathered trees are what ``apex_tpu.checkpoint.save_checkpoint``
+writes (identical on every rank — rank 0 saves); restore is template-
+shaped against a fresh gather on the NEW mesh. All four functions run
+inside ``shard_map`` over ``spec.axis_name``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from apex_tpu.zero.core import (ZeroSpec, gather_tree as _gather_tree,
+                                shard_tree as _shard_tree)
+from apex_tpu.zero.update import Zero3State
+
+__all__ = [
+    "gather_zero3_params", "shard_zero3_params",
+    "gather_zero3_state", "shard_zero3_state",
+]
+
+
+def gather_zero3_params(shards: Any, spec: ZeroSpec) -> Any:
+    """Full (topology-independent) parameter tree from the resident
+    shards — the checkpoint form. Identical on every rank."""
+    return _gather_tree(shards, spec)
+
+
+def shard_zero3_params(params: Any, spec: ZeroSpec) -> Any:
+    """Resident shards of a full tree under the CURRENT mesh — the
+    resume path (build a fresh spec on the new mesh first)."""
+    return _shard_tree(params, spec)
+
+
+def gather_zero3_state(state: Zero3State, spec: ZeroSpec) -> Zero3State:
+    """Topology-independent tier-3 optimizer state: master/m/v gathered
+    to full parameter-shaped fp32 trees (step passes through). What
+    ``save_checkpoint`` should write next to the gathered params."""
+    return Zero3State(
+        step=state.step,
+        master=_gather_tree(state.master, spec),
+        m=_gather_tree(state.m, spec),
+        v=_gather_tree(state.v, spec),
+    )
+
+
+def shard_zero3_state(full_state: Zero3State, spec: ZeroSpec) -> Zero3State:
+    """Local tier-3 state under the CURRENT mesh from a gathered one —
+    dp=8 state resumes on dp=4 (and back) bit-exactly, padded tails
+    included (padding is zeros in every buffer, and zero slots never
+    influence the update)."""
+    return Zero3State(
+        step=full_state.step,
+        master=_shard_tree(full_state.master, spec),
+        m=_shard_tree(full_state.m, spec),
+        v=_shard_tree(full_state.v, spec),
+    )

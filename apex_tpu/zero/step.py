@@ -1,0 +1,130 @@
+"""The ZeRO-3 train step: amp loss scaling + overflow skip over sharded
+parameters, in one traced program.
+
+The amp hot loop (``amp.make_train_step``) with two ZeRO twists:
+
+- gradients arrive as SHARDS (``zero_gather``'s conjugate backward), so
+  the unscale/overflow detection runs on 1/world of the gradient bytes
+  per rank — but each rank then only sees its own partition's infs, so
+  the ``found_inf`` flag is OR-reduced over the zero axis before the
+  skip decision (the exact ``sync_found_inf`` argument from
+  ``amp/scaler.py``: a rank-divergent skip would desynchronize step
+  counters and scaler state forever);
+- the optimizer update is the tier-3 shard update — no parameter
+  all-gather anywhere in the step; the next forward's transient
+  materialization is the only full-param traffic.
+
+Composes with ``amp.initialize(..., opt_level="O2", zero=...)``: the
+returned :class:`~apex_tpu.zero.core.ZeroShardedModel` wraps the
+``AmpModel`` (inputs cast, O2 output recast) and the armed
+``LossScaler`` is picked up from the optimizer's amp stash, so the
+overflow/skip/regrowth machinery is byte-for-byte the dense one.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import scaler as _scaler_mod
+from apex_tpu.amp.scaler import LossScaler, ScalerState
+from apex_tpu.monitor import hooks as _mon
+from apex_tpu.zero import comm as _comm
+from apex_tpu.zero.core import ZeroShardedModel
+
+__all__ = ["make_train_step"]
+
+
+def make_train_step(
+    loss_fn: Callable,
+    zero_model: ZeroShardedModel | None = None,
+    optimizer=None,
+    *,
+    scaler: LossScaler | None = None,
+    has_aux: bool = False,
+    grad_dtype=jnp.float32,
+    donate: bool = True,
+    sync_axes: tuple = (),
+):
+    """Build the jitted ZeRO-3 step (call it inside ``shard_map`` over
+    the zero axis).
+
+    ``loss_fn(full_params, *batch) -> loss`` — written against ORDINARY
+    parameters; the materialization is inserted here, so the same loss
+    function drives the dense and the sharded path (the parity tests
+    literally share it). ``optimizer`` is a
+    :class:`~apex_tpu.zero.optimizer.ZeroOptimizer` with
+    ``shard_params=True``. ``zero_model`` may be omitted when
+    ``amp.initialize(..., zero=...)`` built the wrapper — it is picked
+    up from ``optimizer._zero_model``. ``sync_axes``: extra mesh axes
+    (tensor, pipeline) whose ranks shard gradients and must agree on
+    the skip.
+
+    The returned ``step(shards, opt_state, scaler_state, *batch)``
+    performs: scaled-loss grad (gather behind forward, reduce-scatter
+    behind backward), per-shard unscale + overflow detect, cross-rank
+    OR of ``found_inf``, conditional shard update, dynamic scale update
+    — zero host syncs, zero full-gradient materializations.
+    """
+    if optimizer is None:
+        raise TypeError("make_train_step: optimizer is required")
+    if zero_model is None:
+        zero_model = getattr(optimizer, "_zero_model", None)
+        if zero_model is None:
+            raise ValueError(
+                "make_train_step: pass zero_model, or build it through "
+                "amp.initialize(..., zero=...) so the optimizer carries "
+                "it (optimizer._zero_model)")
+    opt_axis = getattr(optimizer, "axis_name", None)
+    if opt_axis is not None and opt_axis != zero_model.axis_name:
+        raise ValueError(
+            f"make_train_step: optimizer.axis_name={opt_axis!r} does not "
+            f"match zero_model.axis_name={zero_model.axis_name!r}. The "
+            "shard update's collectives would see an unbound axis and "
+            "silently degrade to world=1 (no gradient averaging, identity "
+            "norm psums) while gradients reduce over "
+            f"{zero_model.axis_name!r} — construct the optimizer with "
+            f"axis_name={zero_model.axis_name!r}.")
+    scaler = scaler or (optimizer._amp_stash.loss_scalers[0]
+                        if hasattr(optimizer, "_amp_stash")
+                        else LossScaler(1.0))
+
+    def scaled_loss_fn(shards, scaler_state, *batch):
+        out = loss_fn(zero_model.materialize(shards), *batch)
+        loss, aux = (out if has_aux else (out, None))
+        return _scaler_mod.scale_value(loss, scaler_state), (loss, aux)
+
+    grad_fn = jax.grad(scaled_loss_fn, has_aux=True)
+
+    def step(_mon_on, shards, opt_state, scaler_state: ScalerState, *batch):
+        grads, (loss, aux) = grad_fn(shards, scaler_state, *batch)
+        grads, found_inf = _scaler_mod.unscale(grads, scaler_state,
+                                               out_dtype=grad_dtype)
+        # each rank inspected only its own shards: OR the flag over the
+        # zero axis (and any model-parallel axes) before deciding
+        axes = (zero_model.axis_name,) + tuple(sync_axes)
+        flag = found_inf.astype(jnp.int32)
+        for ax in axes:
+            flag = _comm.psum_flat(flag, ax)
+        found_inf = flag > 0
+        # zero_model.spec is read at trace time, inside the call: the
+        # usual flow builds it (zm.shard) in the same traced program
+        new_shards, new_opt_state = optimizer.apply(
+            opt_state, shards, grads, skip=found_inf, spec=zero_model.spec)
+        new_scaler_state = scaler.update_state(scaler_state, found_inf)
+        outs = (new_shards, new_opt_state, new_scaler_state, loss)
+        return outs + ((aux,) if has_aux else ())
+
+    jitted = jax.jit(step, static_argnums=(0,),
+                     donate_argnums=(1, 2, 3) if donate else ())
+
+    @functools.wraps(step)
+    def run(shards, opt_state, scaler_state: ScalerState, *batch):
+        return jitted(_mon.traced_enabled(), shards, opt_state,
+                      scaler_state, *batch)
+
+    run._jitted = jitted
+    return run

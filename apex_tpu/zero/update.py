@@ -1,0 +1,109 @@
+"""Shared sharded-optimizer update math: ONE implementation of the
+Adam(W) and LAMB step used by every ZeRO tier.
+
+``DistributedFusedAdam``/``DistributedFusedLAMB`` (tier 1/2: flat
+buffer, dynamic per-rank ranges) and :class:`apex_tpu.zero.ZeroOptimizer`
+(tier 3: per-leaf shards, static ranges) differ only in LAYOUT and
+collectives; the element math lives here so the tiers cannot drift.
+Everything is elementwise fp32 (the MXU-free part of the step), shaped
+agnostically — callers pass 1-D flat shards or leaf-shaped arrays alike.
+
+State layouts:
+
+- :class:`ShardedAdamState` / :class:`ShardedLambState` — the tier-1/2
+  flat-shard state (``step`` + three ``[total/world]`` fp32 buffers);
+  re-exported by ``contrib.optimizers`` under the same names.
+- :class:`Zero3State` — the tier-3 state: ``master``/``m``/``v`` are
+  PYTREES mirroring the resident parameter tree (1-D shard per sharded
+  leaf, full array per replicated leaf), all fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ShardedAdamState(NamedTuple):
+    step: jax.Array
+    master_shard: jax.Array   # [total/world] fp32
+    m_shard: jax.Array
+    v_shard: jax.Array
+
+
+class ShardedLambState(NamedTuple):
+    step: jax.Array
+    master_shard: jax.Array
+    m_shard: jax.Array
+    v_shard: jax.Array
+
+
+class Zero3State(NamedTuple):
+    step: jax.Array
+    master: Any               # fp32 pytree of shards/replicated leaves
+    m: Any
+    v: Any
+
+
+def adam_shard_step(p, g, m, v, step, *, lr, betas, eps, weight_decay,
+                    adam_w_mode, bias_correction):
+    """One Adam(W) update on a shard: returns ``(new_p, new_m, new_v)``.
+
+    Exactly the math of ``apex/contrib/optimizers/
+    distributed_fused_adam.py``'s sharded block update (and of this
+    package's pre-unification ``DistributedFusedAdam._do``): optional
+    L2-into-grad (non-AdamW), moment updates, bias correction, AdamW
+    decoupled decay folded into the update term."""
+    b1, b2 = betas
+    if not adam_w_mode and weight_decay:
+        g = g + weight_decay * p
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    if bias_correction:
+        sf = step.astype(jnp.float32)
+        mhat = m / (1 - jnp.power(b1, sf))
+        vhat = v / (1 - jnp.power(b2, sf))
+    else:
+        mhat, vhat = m, v
+    upd = mhat / (jnp.sqrt(vhat) + eps)
+    if adam_w_mode and weight_decay:
+        upd = upd + weight_decay * p
+    return p - lr * upd, m, v
+
+
+def lamb_shard_term(p, g, m, v, step, *, betas, eps, weight_decay,
+                    adam_w_mode, grad_averaging, bias_correction):
+    """The pre-trust-ratio LAMB update term on a shard: returns
+    ``(upd, new_m, new_v)``. The caller computes per-tensor norms of
+    ``p`` and ``upd`` (its layout knows the leaf ranges), applies
+    :func:`lamb_trust_ratio`, and steps ``p - lr * ratio * upd``."""
+    b1, b2 = betas
+    beta3 = (1 - b1) if grad_averaging else 1.0
+    if not adam_w_mode and weight_decay:
+        g = g + weight_decay * p
+    m = b1 * m + beta3 * g
+    v = b2 * v + (1 - b2) * g * g
+    if bias_correction:
+        sf = step.astype(jnp.float32)
+        mhat = m / (1 - jnp.power(b1, sf))
+        vhat = v / (1 - jnp.power(b2, sf))
+    else:
+        mhat, vhat = m, v
+    upd = mhat / (jnp.sqrt(vhat) + eps)
+    if adam_w_mode and weight_decay:
+        upd = upd + weight_decay * p
+    return upd, m, v
+
+
+def lamb_trust_ratio(w_norm, u_norm, *, use_nvlamb, weight_decay):
+    """Per-tensor trust ratio from weight/update norms
+    (``distributed_fused_lamb.py:722-778`` semantics: ratio 1 where
+    either norm vanishes; plain-LAMB skips the ratio entirely at
+    weight_decay=0 unless nvlamb)."""
+    ratio = jnp.where((w_norm > 0) & (u_norm > 0),
+                      w_norm / jnp.maximum(u_norm, 1e-30), 1.0)
+    if not use_nvlamb and weight_decay == 0.0:
+        ratio = jnp.ones_like(ratio)
+    return ratio

@@ -1254,6 +1254,174 @@ def _bench_pp_zero_bubble():
     return {"pp_zero_bubble": out}
 
 
+def _bench_zero_sharded():
+    """ZeRO tier evidence (``apex_tpu.zero``): dense DDP vs ZeRO-2
+    (``DistributedFusedAdam``) vs ZeRO-3 (``ZeroOptimizer
+    (shard_params=True)``) at a matched config on the 8-virtual-device
+    host data mesh —
+
+    - MEASURED per-chip resident param+optimizer bytes (device-local
+      buffer bytes of the live state arrays on device 0: replicated
+      trees hold the full copy, sharded trees 1/world) and the
+      dense/ZeRO-3 shrink ratio,
+    - compiled peak-memory analysis of each step executable
+      (argument/output/temp bytes — XLA's own accounting of the live
+      set, the "compiled peak" view of the same claim),
+    - parity: final params after 3 identical steps, ZeRO-2 and ZeRO-3
+      vs the dense trajectory (fp32 tolerance — psum vs psum_scatter
+      reassociate), and
+    - median step times for the three programs.
+
+    Runs on host CPU devices on purpose (same rationale as
+    ``pp_zero_bubble``): a one-chip TPU has no data axis to shard
+    over; the residency split being measured is backend-independent."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu._compat import shard_map
+    from apex_tpu import zero
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.parallel import allreduce_gradients
+
+    try:
+        devs = jax.devices("cpu")
+    except RuntimeError:
+        devs = jax.devices()
+    world = max(w for w in (8, 4, 2, 1) if w <= len(devs))
+    devs = devs[:world]
+    mesh = Mesh(np.array(devs), ("data",))
+    h, b = 128, 16
+    rng = np.random.RandomState(7)
+    params = {"w1": jnp.asarray(rng.randn(h, h) * 0.2, jnp.float32),
+              "b1": jnp.asarray(rng.randn(h) * 0.1, jnp.float32),
+              "w2": jnp.asarray(rng.randn(h, h) * 0.2, jnp.float32)}
+    x = jnp.asarray(rng.randn(b * world, h), jnp.float32)
+    y = jnp.asarray(rng.randn(b * world, h), jnp.float32)
+    hyper = dict(lr=1e-2, weight_decay=0.01)
+    n_steps = 3
+
+    def loss_fn(p, x, y):
+        return jnp.mean(((jnp.tanh(x @ p["w1"] + p["b1"])) @ p["w2"]
+                         - y) ** 2)
+
+    def per_chip_bytes(tree):
+        dev0 = devs[0]
+        total = 0
+        for leaf in jax.tree.leaves(tree):
+            for sh in getattr(leaf, "addressable_shards", []):
+                if sh.device == dev0:
+                    total += sh.data.nbytes
+        return total
+
+    # the rank-varying/replicated split of each config's state tree,
+    # known statically (the same decision table zero.build_spec uses)
+    decisions = jax.tree.map(
+        lambda d: P("data") if (d and world > 1) else P(),
+        zero.match_zero_rules(None, params))
+    rep = jax.tree.map(lambda _: P(), params)
+    zm3 = zero.ZeroShardedModel(None)   # apply_fn unused: explicit loss
+
+    def build(which):
+        if which == "dense":
+            opt = FusedAdam(params, master_weights=True, **hyper)
+
+            def init(p):
+                return p, opt.init(p)
+
+            def step(p, st, xs, ys):
+                g = jax.grad(loss_fn)(p, xs, ys)
+                g = allreduce_gradients(g, "data")
+                return opt.apply(st, p, g)
+
+            return init, step, (rep, P())
+        if which == "zero2":
+            opt = DistributedFusedAdam(**hyper)
+
+            def init(p):
+                return p, opt.init(p)
+
+            def step(p, st, xs, ys):
+                # raw per-rank grads: DFA's psum_scatter sums, then
+                # gradient_average divides — the dense mean, sharded
+                g = jax.grad(loss_fn)(p, xs, ys)
+                return opt.apply(st, p, g)
+
+            sspec = zero.ShardedAdamState(
+                P(), *((P("data") if world > 1 else P(),) * 3))
+            return init, step, (rep, sspec)
+        opt = zero.ZeroOptimizer(shard_params=True, **hyper)
+
+        def init(p):
+            shards = zm3.shard(p)
+            return shards, opt.init(shards, zm3.spec)
+
+        def step(s, st, xs, ys):
+            g = jax.grad(lambda s: loss_fn(zm3.materialize(s), xs, ys))(s)
+            return opt.apply(st, s, g, spec=zm3.spec)
+
+        sspec = zero.Zero3State(P(), decisions, decisions, decisions)
+        return init, step, (decisions, sspec)
+
+    out = {"world_size": world, "model_param_bytes":
+           sum(int(v.size) * 4 for v in jax.tree.leaves(params))}
+    finals = {}
+    for which in ("dense", "zero2", "zero3"):
+        init, step, state_specs = build(which)
+        jinit = jax.jit(shard_map(init, mesh=mesh, in_specs=(P(),),
+                                  out_specs=state_specs, check_vma=False))
+        p_or_s, st = jinit(params)
+        out[f"{which}_params_opt_bytes_per_chip"] = \
+            per_chip_bytes((p_or_s, st))
+        jstep = jax.jit(shard_map(
+            step, mesh=mesh,
+            in_specs=(*state_specs, P("data"), P("data")),
+            out_specs=state_specs, check_vma=False))
+        ma = jstep.lower(p_or_s, st, x, y).compile().memory_analysis()
+        if ma is not None:
+            out[f"{which}_compiled_bytes"] = {
+                "argument": int(ma.argument_size_in_bytes),
+                "output": int(ma.output_size_in_bytes),
+                "temp": int(ma.temp_size_in_bytes)}
+        for _ in range(n_steps):
+            p_or_s, st = jstep(p_or_s, st, x, y)
+        finals[which] = p_or_s
+        jax.block_until_ready(st)
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            q, _r = jstep(p_or_s, st, x, y)
+            jax.block_until_ready(q)
+            times.append(time.perf_counter() - t0)
+        med, iqr = _median_iqr(times)
+        out[f"{which}_step_ms"] = round(med * 1e3, 3)
+        out[f"{which}_step_iqr_ms"] = round(iqr * 1e3, 4)
+
+    # parity: gather ZeRO-3's shards back to full for comparison
+    # (zm3.spec was built when the zero3 init traced on this mesh)
+    z3_full = jax.jit(shard_map(
+        lambda s: zero.gather_zero3_params(s, zm3.spec), mesh=mesh,
+        in_specs=(decisions,), out_specs=P(),
+        check_vma=False))(finals["zero3"])
+
+    def maxerr(a, b):
+        return max(float(jnp.max(jnp.abs(
+            jnp.asarray(u, jnp.float32) - jnp.asarray(v, jnp.float32))))
+            for u, v in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+    out["zero2_vs_dense_max_abs_err"] = maxerr(finals["dense"],
+                                               finals["zero2"])
+    out["zero3_vs_dense_max_abs_err"] = maxerr(finals["dense"], z3_full)
+    dense_b = out["dense_params_opt_bytes_per_chip"]
+    z3_b = out["zero3_params_opt_bytes_per_chip"]
+    out["dense_over_zero3_bytes_ratio"] = round(dense_b / max(z3_b, 1), 3)
+    out["zero3_step_vs_dense"] = round(
+        out["zero3_step_ms"] / max(out["dense_step_ms"], 1e-9), 3)
+    return {"zero_sharded_step": out}
+
+
 def _bench_gpt_moe():
     """GPT with every-other-block MoE (8 experts, dense mesh —
     single-chip expert compute): the expert-parallel surface's
@@ -1625,6 +1793,7 @@ def _sections_full(ctx: dict, rec) -> list:
         ("tp_overlap", 300, _bench_tp_overlap),
         ("ddp_bucket_overlap", 300, _bench_ddp_bucket_overlap),
         ("pp_zero_bubble", 300, _bench_pp_zero_bubble),
+        ("zero_sharded_step", 300, _bench_zero_sharded),
         ("monitor", 120, lambda: _monitor_extras(rec)),
     ]
     return sections
@@ -1634,7 +1803,8 @@ def _sections_full(ctx: dict, rec) -> list:
 # forcibly timed out (the probe) — asserted after the run
 SMOKE_EXPECTED = ("smoke_mlp_amp", "smoke_fused_adam",
                   "smoke_noop_dispatch", "tp_overlap", "ddp_bucket_overlap",
-                  "pp_zero_bubble", "smoke_timeout_probe", "monitor")
+                  "pp_zero_bubble", "zero_sharded_step",
+                  "smoke_timeout_probe", "monitor")
 
 
 def _sections_smoke(ctx: dict, rec) -> list:
@@ -1722,6 +1892,9 @@ def _sections_smoke(ctx: dict, rec) -> list:
         # same code in smoke and full: the schedule-occupancy mesh is
         # host devices either way (virtual-8 via the module XLA flag)
         ("pp_zero_bubble", 240, _bench_pp_zero_bubble),
+        # same code in smoke and full: the residency split is measured
+        # on the host data mesh either way
+        ("zero_sharded_step", 240, _bench_zero_sharded),
         ("smoke_timeout_probe", probe_budget, timeout_probe),
         ("monitor", 60, lambda: _monitor_extras(rec)),
     ]

@@ -49,11 +49,11 @@ echo "== ci: bench streaming-evidence smoke =="
     BENCH_STREAM_PATH=/tmp/ci_bench_smoke_stream.jsonl \
     python "$REPO_DIR/bench.py" --smoke > /tmp/ci_bench_smoke.json ) || fail=1
 
-echo "== ci: overlap + zero-bubble bench sections in the evidence stream =="
-# the PR-4 overlap sections and the PR-5 pp_zero_bubble section must
-# land as flushed section lines (bench --smoke already asserts
-# SMOKE_EXPECTED; this is the independent driver-side check of the same
-# contract)
+echo "== ci: overlap + zero-bubble + zero-sharded bench sections in the evidence stream =="
+# the PR-4 overlap sections, the PR-5 pp_zero_bubble section and the
+# PR-6 zero_sharded_step section must land as flushed section lines
+# (bench --smoke already asserts SMOKE_EXPECTED; this is the
+# independent driver-side check of the same contract)
 python - /tmp/ci_bench_smoke_stream.jsonl <<'EOF' || fail=1
 import json, sys
 seen = set()
@@ -61,12 +61,13 @@ for line in open(sys.argv[1]):
     ev = json.loads(line)
     if ev.get("kind") == "section":
         seen.add(ev.get("name"))
-missing = {"tp_overlap", "ddp_bucket_overlap", "pp_zero_bubble"} - seen
+missing = {"tp_overlap", "ddp_bucket_overlap", "pp_zero_bubble",
+           "zero_sharded_step"} - seen
 if missing:
     print(f"ci: sections missing from bench stream: {sorted(missing)}")
     raise SystemExit(1)
-print("ci: tp_overlap + ddp_bucket_overlap + pp_zero_bubble present "
-      "in bench stream")
+print("ci: tp_overlap + ddp_bucket_overlap + pp_zero_bubble + "
+      "zero_sharded_step present in bench stream")
 EOF
 
 if [[ "$fail" == "0" ]]; then

@@ -11,6 +11,7 @@ from jax.sharding import PartitionSpec as P
 from apex_tpu._compat import shard_map
 
 from apex_tpu.contrib.optimizers import DistributedFusedAdam, DistributedFusedLAMB
+from apex_tpu.zero.core import pad_to_multiple
 from apex_tpu.optimizers import FusedAdam, FusedLAMB
 from apex_tpu._compat import axis_size as _axis_size
 
@@ -121,7 +122,7 @@ def test_dist_lamb_small_leaf_norms_exact():
     grads = jax.tree.map(lambda p: jnp.ones_like(p) * 1e-3, params)
     opt = DistributedFusedLAMB(lr=1e-2, weight_decay=0.05)
     state = opt.init(params)
-    sums = opt._range_sums(opt._padded(opt._spec.pack(
+    sums = opt._range_sums(pad_to_multiple(opt._spec.pack(
         {"big": params["big"] ** 1, "tiny": params["tiny"]}, jnp.float32), 1) ** 2,
         0, opt._spec.total)
     expected_tiny = float(jnp.sum(params["tiny"] ** 2))
