@@ -1,6 +1,8 @@
-"""apex_tpu.amp — mixed precision with O0–O3 opt levels on TPU.
+"""apex_tpu.amp — mixed precision with O0–O4 opt levels on TPU.
 
-Reference package: ``apex/amp`` (``apex/amp/__init__.py:1-5``).
+Reference package: ``apex/amp`` (``apex/amp/__init__.py:1-5``); the O4
+fp8 level follows the Transformer-Engine delayed-scaling recipe
+(``apex_tpu/amp/fp8.py``).
 """
 
 from apex_tpu.amp.frontend import (  # noqa: F401
@@ -30,3 +32,5 @@ from apex_tpu.amp.policy import (  # noqa: F401
 from apex_tpu.amp.properties import Properties, opt_levels  # noqa: F401
 from apex_tpu.amp.scaler import LossScaler, ScalerState, init_state  # noqa: F401
 from apex_tpu.amp import scaler  # noqa: F401
+from apex_tpu.amp import fp8  # noqa: F401
+from apex_tpu.amp.fp8 import fp8_dot, fp8_matmul, Fp8Meta, Fp8DotMeta  # noqa: F401

@@ -27,6 +27,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.amp import fp8 as _fp8_mod
 from apex_tpu.amp import policy as _policy_mod
 from apex_tpu.amp.lists import o1_interceptor
 from apex_tpu.amp import scaler as _scaler_mod
@@ -109,6 +110,15 @@ class AmpModel:
                                 or _is_norm_param(names))
         return cast_floating(params, ct, keep)
 
+    def init_fp8_state(self, sites) -> dict:
+        """Fresh O4 delayed-scaling state: one
+        :class:`~apex_tpu.amp.fp8.Fp8DotMeta` per named matmul site,
+        with the opt level's ``fp8_history_len``. Valid on any opt
+        level (the metas are inert unless the model routes matmuls
+        through ``amp.fp8.fp8_matmul``)."""
+        return _fp8_mod.init_state(
+            sites, history_len=self.properties.fp8_history_len)
+
     def __call__(self, params, *args, **kwargs):
         p = self.properties
         if p.cast_model_type is not None and p.cast_model_type != jnp.float32:
@@ -176,6 +186,24 @@ def _wrap_zero(zero, model_list, opt_list, amp_model=None):
     return zm
 
 
+class _InertFp8Model:
+    """The O4 face of ``initialize(enabled=False)``: a pass-through
+    apply that still carries :meth:`init_fp8_state`, so the documented
+    O4 recipe (``model.init_fp8_state(sites)`` → ``make_train_step
+    (fp8=True)``) runs at full precision with unchanged call sites
+    (``amp.fp8``'s primitives are inert under the same flag)."""
+
+    def __init__(self, apply_fn, history_len: int):
+        self._apply = apply_fn
+        self._history_len = int(history_len)
+
+    def __call__(self, params, *args, **kwargs):
+        return self._apply(params, *args, **kwargs)
+
+    def init_fp8_state(self, sites) -> dict:
+        return _fp8_mod.init_state(sites, history_len=self._history_len)
+
+
 def initialize(
     models,
     optimizers=None,
@@ -196,6 +224,8 @@ def initialize(
     max_loss_scale: float = 2.0 ** 24,
     keep_fp32_predicate: Callable | None = None,
     zero=None,
+    fp8_history_len: int | None = None,
+    fp8_margin: float | None = None,
 ):
     """Initialize amp. Reference: ``amp.initialize`` ``apex/amp/frontend.py:195-358``.
 
@@ -242,10 +272,24 @@ def initialize(
         _amp_state.enabled = False
         _amp_state.opt_properties = None
         _amp_state.loss_scalers = []
+        # the fp8 (O4) surface survives disablement inert-but-present:
+        # fp8_matmul degrades to the plain fp32-accumulated matmul and
+        # update_state to the identity, so O4-written steps run at full
+        # precision with unchanged signatures (the same class of
+        # contract as the zero= wrapper surviving below — PR 6's
+        # enabled=False wrapper-drop bug, now for fp8-meta callers)
+        _fp8_mod.set_enabled(False)
         maybe_print("amp disabled (enabled=False): pass-through", True)
 
         def _plain(m):
-            return m.apply if hasattr(m, "apply") else m
+            fn = m.apply if hasattr(m, "apply") else m
+            if opt_level == "O4":
+                # O4 callers are written against model.init_fp8_state
+                # (docs/amp.md recipe) — returning the bare function
+                # would crash them, the PR-6 wrapper-drop bug class.
+                # Everything else keeps apex's unmodified-model parity.
+                return _InertFp8Model(fn, fp8_history_len or 16)
+            return fn
         if isinstance(models, (list, tuple)):
             out_models = type(models)(_plain(m) for m in models)
         else:
@@ -268,13 +312,14 @@ def initialize(
             return out_models
         return out_models, optimizers
     _amp_state.enabled = True
+    _fp8_mod.set_enabled(True)   # re-arm after any earlier enabled=False
     if patch_torch_functions is not None and cast_ops is None:
         # the reference's O1 knob name (apex/amp/frontend.py:201): there
         # is no torch namespace to patch on TPU — the equivalent scope
         # is the op-registry autocast, i.e. cast_ops
         cast_ops = patch_torch_functions
     if opt_level not in opt_levels:
-        raise RuntimeError(f"Unexpected optimization level {opt_level}. Options are 'O0', 'O1', 'O2', 'O3'.")
+        raise RuntimeError(f"Unexpected optimization level {opt_level}. Options are 'O0', 'O1', 'O2', 'O3', 'O4'.")
 
     properties = Properties()
     if half_dtype is not None:
@@ -290,6 +335,11 @@ def initialize(
         master_weights=master_weights,
         loss_scale=loss_scale,
         cast_model_outputs=cast_model_outputs,
+        # the O4 delayed-scaling knobs (TE DelayedScaling's
+        # amax_history_len / margin; live on any opt level, consumed by
+        # init_fp8_state and make_train_step(fp8=True))
+        fp8_history_len=fp8_history_len,
+        fp8_margin=fp8_margin,
     )
     for k, v in overrides.items():
         if v is not None:
@@ -400,6 +450,8 @@ def make_train_step(
     has_aux: bool = False,
     grad_dtype=jnp.float32,
     donate: bool = True,
+    fp8: bool = False,
+    fp8_margin: float | None = None,
 ):
     """Build a jitted training step with amp semantics.
 
@@ -413,6 +465,18 @@ def make_train_step(
     (apex patches ``optimizer.step`` to a no-op; here it is a ``jnp.where``
     on the update), and dynamic scale update — all inside one XLA program.
 
+    ``fp8=True`` (the O4 hot loop): ``loss_fn(params, fp8_state, *batch)``
+    and the step becomes ``step(params, opt_state, scaler_state,
+    fp8_state, *batch)`` returning the updated fp8 state fourth — the
+    delayed-scaling amax tree is threaded and DONATED alongside the
+    scaler state. The gradient pass records every fp8 tensor's amax as
+    the cotangent of its meta (``amp.fp8`` module doc), the step applies
+    the delayed-scaling update, and an overflow skip leaves the amax
+    history bitwise untouched (an inf backward must not enter the
+    statistics — the same contract as the O2 master-weight skip).
+    ``fp8_margin`` defaults from the optimizer's amp properties
+    (``Properties.fp8_margin``, settable via ``initialize``), else 0.
+
     The monitoring guard rides along as a static jit argument (a bool:
     is a traced-hooks recorder attached?): attaching or detaching a
     ``apex_tpu.monitor`` recorder switches between exactly two cached
@@ -425,6 +489,16 @@ def make_train_step(
     """
     scaler = scaler or (optimizer._amp_stash.loss_scalers[0]
                         if hasattr(optimizer, "_amp_stash") else LossScaler(1.0))
+
+    if fp8:
+        return _make_fp8_train_step(loss_fn, optimizer, scaler,
+                                    has_aux=has_aux, grad_dtype=grad_dtype,
+                                    donate=donate, fp8_margin=fp8_margin)
+    if fp8_margin is not None:
+        raise ValueError(
+            "make_train_step: fp8_margin is only meaningful with "
+            "fp8=True (the O4 delayed-scaling step); without it the "
+            "margin would be silently ignored")
 
     def scaled_loss_fn(params, scaler_state, *batch):
         out = loss_fn(params, *batch)
@@ -453,4 +527,58 @@ def make_train_step(
                       scaler_state, *batch)
 
     run._jitted = jitted   # escape hatch: .lower()/.trace() on the inner fn
+    return run
+
+
+def _make_fp8_train_step(loss_fn, optimizer, scaler, *, has_aux,
+                         grad_dtype, donate, fp8_margin):
+    """The O4 variant of the hot loop (see :func:`make_train_step`,
+    ``fp8=True``): one ``jax.grad`` over ``(params, fp8_state)`` yields
+    the parameter grads AND the recorded amaxes, so the whole
+    scale → grad → unscale → cond-skip → delayed-scaling-update →
+    scale-update pipeline is still a single XLA program with zero host
+    syncs."""
+    if fp8_margin is None:
+        stash = getattr(optimizer, "_amp_stash", None)
+        fp8_margin = (stash.properties.fp8_margin if stash is not None
+                      else 0.0)
+
+    def scaled_loss_fn(params, fp8_state, scaler_state, *batch):
+        out = loss_fn(params, fp8_state, *batch)
+        loss, aux = (out if has_aux else (out, None))
+        return _scaler_mod.scale_value(loss, scaler_state), (loss, aux)
+
+    grad_fn = jax.grad(scaled_loss_fn, argnums=(0, 1), has_aux=True)
+
+    def step(_mon_on, params, opt_state, scaler_state: ScalerState,
+             fp8_state, *batch):
+        (grads, recorded), (loss, aux) = grad_fn(
+            params, fp8_state, scaler_state, *batch)
+        grads, found_inf = _scaler_mod.unscale(grads, scaler_state,
+                                               out_dtype=grad_dtype)
+        new_params, new_opt_state = optimizer.apply(
+            opt_state, params, grads, skip=found_inf
+        )
+        updated = _fp8_mod.update_state(fp8_state, recorded,
+                                        margin=fp8_margin)
+        # overflow: the recorded amaxes came from an inf/nan backward —
+        # keep the history bitwise untouched (the O2 master-skip
+        # contract, tests/test_fp8.py)
+        new_fp8 = jax.tree.map(
+            lambda new, old: jnp.where(found_inf, old, new),
+            updated, fp8_state)
+        new_scaler_state = scaler.update_state(scaler_state, found_inf)
+        outs = (new_params, new_opt_state, new_scaler_state, new_fp8, loss)
+        return outs + ((aux,) if has_aux else ())
+
+    jitted = jax.jit(step, static_argnums=(0,),
+                     donate_argnums=(1, 2, 3, 4) if donate else ())
+
+    @functools.wraps(step)
+    def run(params, opt_state, scaler_state: ScalerState, fp8_state,
+            *batch):
+        return jitted(_mon.traced_enabled(), params, opt_state,
+                      scaler_state, fp8_state, *batch)
+
+    run._jitted = jitted
     return run

@@ -1,4 +1,4 @@
-"""Opt-level properties: O0–O3 precision policies.
+"""Opt-level properties: O0–O4 precision policies.
 
 Reference: ``apex/amp/frontend.py:7-191`` — a ``Properties`` object with
 per-property consistency validation in ``__setattr__`` plus four canned opt
@@ -38,6 +38,8 @@ class Properties:
             "master_weights": None,        # keep fp32 master params in optimizer
             "loss_scale": 1.0,             # float or "dynamic"
             "half_dtype": jnp.bfloat16,    # what "half" means on this device
+            "fp8_history_len": 16,         # O4: amax ring length per tensor
+            "fp8_margin": 0.0,             # O4: scale headroom, powers of two
         }
 
     def _update_options_dict(self, new_options: dict):
@@ -148,4 +150,40 @@ class O0:
         return properties
 
 
-opt_levels = {"O3": O3(), "O2": O2(), "O1": O1(), "O0": O0()}
+class O4:
+    """fp8 matmuls with per-tensor delayed scaling, on the O2 recipe.
+
+    No apex analog — the Transformer-Engine ``DelayedScaling`` recipe
+    (e4m3 forward activations/weights, e5m2 cotangents, per-tensor amax
+    history) grafted onto this package's opt-level frame: everything the
+    model does NOT route through ``amp.fp8.fp8_matmul`` runs exactly
+    like O2 (half storage, fp32 batchnorm, fp32 master weights), and the
+    fp8 sites carry their own :class:`~apex_tpu.amp.fp8.Fp8DotMeta`
+    state threaded by ``make_train_step(..., fp8=True)``.
+
+    ``loss_scale``: every *fp8-consumed* gradient is governed by its
+    tensor's own e5m2 delayed scale, so the global loss scale is
+    redundant for those leaves; it exists purely for the NON-fp8 leaves
+    (norm params, biases, embeddings outside fp8 sites), and therefore
+    defaults exactly like O2 — ``"dynamic"`` iff the half dtype is fp16
+    (bf16 shares the fp32 exponent range and needs no scaling). The
+    overflow skip never touches the amax history (tested).
+    """
+
+    brief = ("O4: fp8 matmuls (e4m3 fwd / e5m2 grads, delayed scaling) "
+             "over the O2 master-weight recipe.")
+
+    def __call__(self, properties: Properties) -> Properties:
+        properties.enabled = True
+        properties.opt_level = "O4"
+        properties.cast_model_type = properties.half_dtype
+        properties.cast_ops = False
+        properties.keep_batchnorm_fp32 = True
+        properties.master_weights = True
+        properties.loss_scale = (
+            "dynamic" if properties.half_dtype == jnp.float16 else 1.0
+        )
+        return properties
+
+
+opt_levels = {"O4": O4(), "O3": O3(), "O2": O2(), "O1": O1(), "O0": O0()}

@@ -334,6 +334,55 @@ def _zero3_train_step():
     return fn, (params, x, y), mesh.axis_names
 
 
+def _fp8_train_step():
+    """The O4 hot loop (``amp.make_train_step(fp8=True)``): fp8 matmuls
+    through the delayed-scaling codec, amax recorded as meta cotangents,
+    grad unscale + overflow skip + delayed-scaling update + scale update
+    in one jitted program — plus the fp8-compressed bucketed gradient
+    all-reduce (``compress="fp8"``), whose per-bucket amax pmax and fp8
+    psum must ride the canonical data axis."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from apex_tpu import amp
+    from apex_tpu._compat import shard_map
+    from apex_tpu.amp import fp8 as fp8_mod
+    from apex_tpu.amp import scaler as scaler_mod
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.parallel.overlap import bucketed_allreduce
+    from apex_tpu.transformer import parallel_state as ps
+
+    mesh, _, _ = _mesh_for()
+
+    def loss_fn(params, fstate, x, y):
+        h = jnp.tanh(fp8_mod.fp8_matmul(x, params["w1"], fstate["l1"]))
+        o = fp8_mod.fp8_matmul(h, params["w2"], fstate["l2"])
+        return jnp.mean((o - y) ** 2)
+
+    opt = FusedAdam(lr=1e-3)
+    step = amp.make_train_step(loss_fn, opt, fp8=True, donate=False)
+
+    def run(params, fstate, x, y):
+        opt_state = opt.init(params)
+        sstate = scaler_mod.init_state()
+        out = step(params, opt_state, sstate, fstate, x, y)
+        new_params = out[0]
+        # the O4 comm path: the fresh params stand in for a grad tree
+        # so the fp8 bucket collectives enter the gated jaxpr
+        reduced = bucketed_allreduce(new_params, ps.DATA_AXIS,
+                                     message_size=256, compress="fp8")
+        return reduced, out[3]
+
+    fn = shard_map(run, mesh=mesh, in_specs=(P(), P(), P(), P()),
+                   out_specs=(P(), P()), check_vma=False)
+    params = {"w1": jnp.zeros((4, 8), jnp.float32),
+              "w2": jnp.zeros((8, 2), jnp.float32)}
+    fstate = fp8_mod.init_state(["l1", "l2"], history_len=4)
+    x = jnp.zeros((2, 4), jnp.float32)
+    y = jnp.zeros((2, 2), jnp.float32)
+    return fn, (params, fstate, x, y), mesh.axis_names
+
+
 def _fused_lm_head_ce():
     """Vocab-parallel fused LM-head CE: the pmax/psum trio over the
     tensor axis, plus the Pallas kernels in interpret mode."""
@@ -369,4 +418,5 @@ register_entrypoint("pp_zero_bubble_step", _pp_zero_bubble_step)
 register_entrypoint("pp_zero_bubble_interleaved_step",
                     _pp_zero_bubble_interleaved_step)
 register_entrypoint("zero3_train_step", _zero3_train_step)
+register_entrypoint("fp8_train_step", _fp8_train_step)
 register_entrypoint("fused_lm_head_ce", _fused_lm_head_ce)

@@ -140,6 +140,7 @@ class DistributedDataParallel:
                  gradient_predivide_factor: float = 1.0,
                  gradient_average_split_factor=None,
                  overlap_comm: bool = False,
+                 compress: str | None = None,
                  prof: bool = False):
         self.module = module
         self.axis_name = axis_name
@@ -149,6 +150,27 @@ class DistributedDataParallel:
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
         self.overlap_comm = overlap_comm
+        # ``compress="fp8"`` — the amp O4 gradient-comm path: each
+        # message_size bucket is pmax-amax'd, cast to float8_e5m2
+        # through the shared amp.fp8 codec, psummed in the wire dtype
+        # and rescaled (overlap.bucketed_allreduce). Opt-in and lossy
+        # (e5m2 has 2 mantissa bits) — never a default.
+        if compress not in (None, "fp8"):
+            raise ValueError(
+                f"DistributedDataParallel: compress must be None or "
+                f"'fp8', got {compress!r}")
+        if compress and not overlap_comm:
+            raise ValueError(
+                "DistributedDataParallel: compress='fp8' requires "
+                "overlap_comm=True — the fp8 codec scales per "
+                "message_size bucket (parallel/overlap.py), so there "
+                "is no bucket to scale on the per-leaf path")
+        if compress and allreduce_always_fp32:
+            raise ValueError(
+                "DistributedDataParallel: compress='fp8' contradicts "
+                "allreduce_always_fp32=True (one narrows the wire to "
+                "1 byte/elt, the other widens it to 4)")
+        self.compress = compress
         # ``overlap_comm=True`` gives ``message_size`` real TPU semantics:
         # ``flush``/``sync``/``accumulate`` partition the grad tree into
         # message_size-byte buckets and issue one fused psum per bucket
@@ -205,6 +227,7 @@ class DistributedDataParallel:
             from apex_tpu.parallel.overlap import bucketed_allreduce
             return bucketed_allreduce(grads, self.axis_name,
                                       message_size=self.message_size,
+                                      compress=self.compress,
                                       **self._scaling())
         return allreduce_gradients(grads, self.axis_name, **self._scaling())
 
@@ -219,7 +242,8 @@ class DistributedDataParallel:
         return accumulate_gradients(
             grad_fn, params, microbatches, axis_name=self.axis_name,
             message_size=self.message_size, overlap_comm=self.overlap_comm,
-            delay_allreduce=self.delay_allreduce, **self._scaling())
+            delay_allreduce=self.delay_allreduce, compress=self.compress,
+            **self._scaling())
 
 
 class Reducer:

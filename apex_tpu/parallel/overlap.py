@@ -44,6 +44,10 @@ apex's ``message_size`` knob real TPU semantics:
   a gradient tree into ``message_size``-byte buckets, one fused ``psum``
   per bucket; in the gradient-accumulation loop each microbatch's bucket
   psums are issued data-independent of the next microbatch's compute.
+  ``compress="fp8"`` (the amp O4 comm path) quantizes each bucket to
+  float8_e5m2 through the shared ``amp.fp8`` codec before the psum, so
+  the collective's operands — and the accounted wire bytes — are 1
+  byte/element: half of bf16, a quarter of fp32.
 
 Numerics: ``all_gather_matmul`` is *bitwise* identical to the gather-
 then-matmul program (each output row block is the same full-contraction
@@ -426,6 +430,7 @@ def bucketed_allreduce(
     gradient_average: bool = True,
     allreduce_always_fp32: bool = False,
     gradient_predivide_factor: float = 1.0,
+    compress: str | None = None,
 ) -> Any:
     """``allreduce_gradients`` with apex's bucket semantics made real:
     one fused ``psum`` *per bucket* instead of one per leaf.
@@ -438,9 +443,33 @@ def bucketed_allreduce(
     options match :func:`apex_tpu.parallel.allreduce_gradients` exactly;
     per-leaf numerics are identical to the unbucketed path (bucketing
     changes grouping, not any leaf's reduction).
+
+    ``compress="fp8"`` — the amp O4 gradient-comm path (the ONE fp8
+    codec, ``apex_tpu.amp.fp8``; ``zero.comm.quantized_all_gather
+    (scaled=True)`` is the parameter-gather face of the same helpers):
+    each bucket takes one cross-rank amax (a scalar ``pmax``), scales by
+    ``E5M2_MAX / (amax * world)`` — the ``world`` predivide guarantees
+    no partial sum of the psum can exceed the e5m2 max, so accumulation
+    in the wire dtype cannot saturate — casts to float8_e5m2, psums the
+    fp8 operands in ONE eqn, and rescales. Wire (and accounted) bytes
+    per bucket are 1 byte/element vs 2 for bf16 / 4 for fp32; numerics
+    are e5m2-lossy (2 mantissa bits — relative error ~2^-2 per leaf
+    value; gradient *direction* is preserved, see docs/perf.md), so this
+    is an opt-in, never a default. Incompatible with
+    ``allreduce_always_fp32`` (the knobs contradict: one widens the
+    wire, the other narrows it).
     """
     from apex_tpu.parallel.distributed import (_postscale_leaf,
                                                _prescale_leaf)
+
+    if compress not in (None, "fp8"):
+        raise ValueError(f"compress must be None or 'fp8', got {compress!r}")
+    if compress == "fp8":
+        from apex_tpu.amp import fp8 as _fp8
+    if compress and allreduce_always_fp32:
+        raise ValueError(
+            "compress='fp8' contradicts allreduce_always_fp32=True: one "
+            "narrows the wire to 1 byte/elt, the other widens it to 4")
 
     world = _axis_size(axis_name)
     leaves, treedef = jax.tree.flatten(grads)
@@ -450,10 +479,27 @@ def bucketed_allreduce(
     for bucket in buckets:
         ops = [_prescale_leaf(leaves[i], allreduce_always_fp32,
                               gradient_predivide_factor) for i in bucket]
-        if _mon.traced_enabled():
-            _mon.collective("psum", axis_name, nbytes=_mon.tree_bytes(ops),
-                            count=1)
-        reduced = jax.lax.psum(tuple(ops), axis_name)   # ONE eqn per bucket
+        if compress == "fp8":
+            # one delayed-scaling-style scale per bucket, agreed across
+            # ranks (pmax of the local amaxes — a 4-byte scalar, counted
+            # in the accounting so the byte comparison stays honest)
+            local_amax = jnp.max(jnp.stack([_fp8.amax(g) for g in ops]))
+            bucket_amax = jax.lax.pmax(local_amax, axis_name)
+            if _mon.traced_enabled():
+                _mon.collective("pmax", axis_name, nbytes=4, count=1)
+            scale = _fp8.compute_scale(bucket_amax * world, _fp8.E5M2_MAX)
+            wire = tuple(_fp8.quantize(g, scale, _fp8.E5M2) for g in ops)
+            if _mon.traced_enabled():
+                _mon.collective("psum", axis_name,
+                                nbytes=_mon.tree_bytes(wire), count=1)
+            summed = jax.lax.psum(wire, axis_name)   # fp8 on the wire
+            reduced = [_fp8.dequantize(q, scale, jnp.float32)
+                       for q in summed]
+        else:
+            if _mon.traced_enabled():
+                _mon.collective("psum", axis_name,
+                                nbytes=_mon.tree_bytes(ops), count=1)
+            reduced = jax.lax.psum(tuple(ops), axis_name)  # ONE eqn/bucket
         for i, g in zip(bucket, reduced):
             out[i] = _postscale_leaf(g, leaves[i].dtype, world,
                                      gradient_average,
@@ -473,6 +519,7 @@ def accumulate_gradients(
     gradient_average: bool = True,
     allreduce_always_fp32: bool = False,
     gradient_predivide_factor: float = 1.0,
+    compress: str | None = None,
 ) -> Any:
     """Gradient accumulation with the reduction placed for overlap.
 
@@ -497,9 +544,16 @@ def accumulate_gradients(
 
     All three modes compute the same value (psum is linear; per-leaf
     tolerance only from fp reassociation in the streamed mode).
+    ``compress="fp8"`` rides the bucketed paths (see
+    :func:`bucketed_allreduce`; requires ``overlap_comm=True`` — the
+    per-leaf fallback has no bucket to scale).
     """
     if not len(microbatches):
         raise ValueError("accumulate_gradients: need at least 1 microbatch")
+    if compress and not overlap_comm:
+        raise ValueError(
+            "compress='fp8' requires overlap_comm=True: the fp8 codec "
+            "scales per message_size bucket (bucketed_allreduce)")
     scaling = dict(gradient_average=gradient_average,
                    allreduce_always_fp32=allreduce_always_fp32,
                    gradient_predivide_factor=gradient_predivide_factor)
@@ -507,12 +561,12 @@ def accumulate_gradients(
     for mb in microbatches:
         g = grad_fn(params, mb)
         if overlap_comm and not delay_allreduce:
-            g = bucketed_allreduce(g, axis_name,
-                                   message_size=message_size, **scaling)
+            g = bucketed_allreduce(g, axis_name, message_size=message_size,
+                                   compress=compress, **scaling)
         acc = g if acc is None else jax.tree.map(jnp.add, acc, g)
     if overlap_comm and delay_allreduce:
-        acc = bucketed_allreduce(acc, axis_name,
-                                 message_size=message_size, **scaling)
+        acc = bucketed_allreduce(acc, axis_name, message_size=message_size,
+                                 compress=compress, **scaling)
     elif not overlap_comm:
         from apex_tpu.parallel.distributed import allreduce_gradients
         acc = allreduce_gradients(acc, axis_name, **scaling)

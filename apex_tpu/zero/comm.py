@@ -19,7 +19,12 @@ reimplementation:
   (``apex/contrib/optimizers/distributed_fused_adam.py:477``): cast the
   shard to a narrow wire dtype, gather, cast back. Master state stays
   exact; only the broadcast copy is quantized. Wire bytes are accounted
-  at the narrow dtype — that is the point of the knob.
+  at the narrow dtype — that is the point of the knob. ``scaled=True``
+  routes the cast through the shared amp O4 fp8 codec
+  (``apex_tpu.amp.fp8``) — amax-scaled before quantization, the same
+  helpers ``parallel.overlap.bucketed_allreduce(compress="fp8")`` uses
+  for gradient buckets, so ZeRO's param gather and the DDP bucket path
+  put bitwise-identical codec numerics on the wire.
 """
 
 from __future__ import annotations
@@ -81,13 +86,39 @@ def psum_flat(x, axis_name: str):
 
 def quantized_all_gather(shard, axis_name: str, *,
                          wire_dtype=jnp.float8_e5m2, out_dtype=None,
-                         overlap_comm: bool = False):
+                         overlap_comm: bool = False,
+                         scaled: bool = False):
     """All-gather ``shard`` through a narrow wire dtype.
 
     The returned buffer is ``out_dtype`` (default: the shard's own
     dtype); every block — including the local one, for cross-rank
-    bitwise consistency — has round-tripped through ``wire_dtype``."""
+    bitwise consistency — has round-tripped through ``wire_dtype``.
+
+    ``scaled=False`` (default) is the reference's raw cast
+    (``apex/contrib/optimizers/distributed_fused_adam.py:477`` —
+    bitwise-documented, values outside the wire format's range are the
+    cast's problem). ``scaled=True`` routes through the shared amp O4
+    codec (``apex_tpu.amp.fp8`` — the same quantize/dequantize helpers
+    as ``parallel.overlap.bucketed_allreduce(compress="fp8")``): the
+    shard's cross-rank amax (a scalar ``pmax``, accounted) positions
+    the whole tensor inside the format before the cast, so a master
+    buffer whose values exceed e5m2's 57344 max — or sit deep in its
+    subnormal range — survives the wire. The scale is derived from the
+    gathered tensor's own statistics, never stored: dequantize happens
+    immediately after the gather."""
     out_dtype = shard.dtype if out_dtype is None else out_dtype
-    wire = shard.astype(wire_dtype)
-    return all_gather_flat(wire, axis_name,
-                           overlap_comm=overlap_comm).astype(out_dtype)
+    if not scaled:
+        wire = shard.astype(wire_dtype)
+        return all_gather_flat(wire, axis_name,
+                               overlap_comm=overlap_comm).astype(out_dtype)
+    from apex_tpu.amp import fp8 as _fp8
+    local_amax = _fp8.amax(shard)
+    if _world_of(axis_name) > 1:
+        _account("pmax", axis_name, local_amax)
+        tensor_amax = jax.lax.pmax(local_amax, axis_name)
+    else:
+        tensor_amax = local_amax
+    scale = _fp8.compute_scale(tensor_amax, _fp8.fp8_max(wire_dtype))
+    wire = _fp8.quantize(shard, scale, wire_dtype)
+    full = all_gather_flat(wire, axis_name, overlap_comm=overlap_comm)
+    return _fp8.dequantize(full, scale, out_dtype)

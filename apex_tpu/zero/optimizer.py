@@ -76,7 +76,7 @@ class ZeroOptimizer:
                  max_grad_norm: float | None = None,
                  use_nvlamb: bool = False,
                  axis_name: str = "data", overlap_comm: bool = False,
-                 compress_allgather: bool = False,
+                 compress_allgather: bool | str = False,
                  spec: ZeroSpec | None = None):
         if kind not in ("adam", "lamb"):
             raise ValueError(f"kind must be 'adam' or 'lamb', got {kind!r}")
@@ -93,6 +93,13 @@ class ZeroOptimizer:
         self.use_nvlamb = use_nvlamb
         self.axis_name = axis_name
         self.overlap_comm = overlap_comm
+        # True = the reference's raw e5m2 cast (bitwise-documented);
+        # "scaled" = the amp O4 codec (amax-scaled before the cast —
+        # survives values outside e5m2's range; zero/comm.py)
+        if compress_allgather not in (False, True, "scaled"):
+            raise ValueError(
+                f"compress_allgather must be False, True or 'scaled', "
+                f"got {compress_allgather!r}")
         self.compress_allgather = compress_allgather
         self._zspec = spec
         self._spec: FlatBuffer | None = None   # tier-1/2 flat layout
@@ -257,7 +264,8 @@ class ZeroOptimizer:
         if self.compress_allgather:
             flat_new = _comm.quantized_all_gather(
                 new_state.master_shard, self.axis_name,
-                out_dtype=jnp.float32, overlap_comm=self.overlap_comm)
+                out_dtype=jnp.float32, overlap_comm=self.overlap_comm,
+                scaled=(self.compress_allgather == "scaled"))
         else:
             flat_new = _comm.all_gather_flat(
                 new_state.master_shard, self.axis_name,

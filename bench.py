@@ -1422,6 +1422,141 @@ def _bench_zero_sharded():
     return {"zero_sharded_step": out}
 
 
+def _bench_fp8_step():
+    """amp O4 evidence (PR 7): the fp8 delayed-scaling step and the
+    fp8-compressed gradient comm, at matched config against bf16 —
+
+    - step time of ``amp.make_train_step(fp8=True)`` (e4m3 matmuls,
+      e5m2 cotangents, amax recording + delayed-scaling update fused
+      into the step) vs the same model's bf16 step (informational on
+      CPU, where ml_dtypes emulates the casts — the codec runs for
+      real, the speed story is TPU-only),
+    - trace-time comm bytes of ``bucketed_allreduce(compress="fp8")``
+      vs the bf16 bucket path on the virtual-8 data mesh: fp8 wire is
+      1 byte/elt vs 2, so psum+pmax bytes must land <= 0.55x
+      (asserted here AND in tests/test_fp8.py), and
+    - fp8-vs-fp32 reduction error for the same gradient tree (the
+      e5m2 2-mantissa-bit price, documented in docs/perf.md)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import AbstractMesh, Mesh, PartitionSpec as P
+
+    from apex_tpu import amp, monitor
+    from apex_tpu._compat import shard_map
+    from apex_tpu.amp import fp8 as fp8_mod
+    from apex_tpu.amp import scaler as scaler_mod
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.parallel.overlap import bucketed_allreduce
+
+    rng = np.random.RandomState(7)
+    d, h, o, b = 32, 64, 8, 16
+    params = {"w1": jnp.asarray(rng.randn(d, h) * 0.2, jnp.float32),
+              "w2": jnp.asarray(rng.randn(h, o) * 0.2, jnp.float32)}
+    x = jnp.asarray(rng.randn(b, d), jnp.float32)
+    y = jnp.asarray(rng.randn(b, o), jnp.float32)
+    opt = FusedAdam(lr=1e-3)
+
+    def fp8_loss(p, fstate, xb, yb):
+        hh = jnp.tanh(fp8_mod.fp8_matmul(xb, p["w1"], fstate["l1"]))
+        return jnp.mean((fp8_mod.fp8_matmul(hh, p["w2"], fstate["l2"])
+                         - yb) ** 2)
+
+    def bf16_loss(p, xb, yb):
+        # the O2 shape of the same model: bf16 storage, fp32 accumulate
+        hh = jnp.tanh(jnp.dot(xb.astype(jnp.bfloat16),
+                              p["w1"].astype(jnp.bfloat16),
+                              preferred_element_type=jnp.float32))
+        return jnp.mean((jnp.dot(hh.astype(jnp.bfloat16),
+                                 p["w2"].astype(jnp.bfloat16),
+                                 preferred_element_type=jnp.float32)
+                         - yb) ** 2)
+
+    def time_loop(step_once, n=20):
+        step_once()                       # compile
+        t0 = time.perf_counter()
+        for _ in range(n):
+            step_once()
+        return (time.perf_counter() - t0) / n
+
+    o4 = {"params": params, "opt": opt.init(params),
+          "sstate": scaler_mod.init_state(),
+          "fstate": fp8_mod.init_state(["l1", "l2"])}
+    step4 = amp.make_train_step(fp8_loss, opt, fp8=True, donate=False)
+
+    def one_o4():
+        o4["params"], o4["opt"], o4["sstate"], o4["fstate"], loss = \
+            step4(o4["params"], o4["opt"], o4["sstate"], o4["fstate"], x, y)
+        float(loss)
+
+    o2 = {"params": params, "opt": opt.init(params),
+          "sstate": scaler_mod.init_state()}
+    step2 = amp.make_train_step(bf16_loss, opt, donate=False)
+
+    def one_o2():
+        o2["params"], o2["opt"], o2["sstate"], loss = \
+            step2(o2["params"], o2["opt"], o2["sstate"], x, y)
+        float(loss)
+
+    out = {"fp8_step_ms": round(time_loop(one_o4) * 1e3, 3),
+           "bf16_step_ms": round(time_loop(one_o2) * 1e3, 3),
+           "fp8_final_loss": round(float(fp8_loss(
+               o4["params"], o4["fstate"], x, y)), 6),
+           "bf16_final_loss": round(float(bf16_loss(
+               o2["params"], x, y)), 6),
+           "fp8_l1_x_scale": round(float(o4["fstate"]["l1"].x.scale), 4)}
+
+    # comm bytes at matched config: same grad tree (bf16 leaves), same
+    # message_size buckets; trace-only on the virtual-8 data mesh so
+    # the accounting works deviceless
+    grads = {"w1": jnp.asarray(rng.randn(d, h), jnp.bfloat16),
+             "w2": jnp.asarray(rng.randn(h, o), jnp.bfloat16)}
+    message_size = 2048
+    am = AbstractMesh((("data", 8),))
+
+    def trace_bytes(compress):
+        rec = monitor.Recorder(name="bench-fp8-bytes", capacity=256)
+        fn = shard_map(
+            lambda g: bucketed_allreduce(g, "data",
+                                         message_size=message_size,
+                                         compress=compress),
+            mesh=am, in_specs=(P(),), out_specs=P(), check_vma=False)
+        with monitor.attached(rec):
+            jax.make_jaxpr(fn)(grads)
+        table = rec.collectives()
+        return sum(v["bytes"] for k, v in table.items()
+                   if k.endswith("@data"))
+
+    bf16_bytes = trace_bytes(None)
+    fp8_bytes = trace_bytes("fp8")
+    ratio = fp8_bytes / max(bf16_bytes, 1)
+    out.update({"bucket_bytes_bf16": bf16_bytes,
+                "bucket_bytes_fp8": fp8_bytes,
+                "bucket_bytes_ratio": round(ratio, 4)})
+    # the acceptance bound: fp8 buckets move <= 0.55x the bf16 bytes
+    # (0.5 from the 1-vs-2-byte wire + the per-bucket amax pmax scalars)
+    assert ratio <= 0.55, \
+        f"fp8 bucket bytes ratio {ratio:.4f} > 0.55 vs bf16"
+
+    # reduction-error price of the e5m2 wire, on whatever mesh exists
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    fgrads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+
+    def reduce_with(compress):
+        return shard_map(
+            lambda g: bucketed_allreduce(g, "data",
+                                         message_size=message_size,
+                                         compress=compress),
+            mesh=mesh, in_specs=(P(),), out_specs=P(),
+            check_vma=False)(fgrads)
+
+    exact, lossy = reduce_with(None), reduce_with("fp8")
+    out["fp8_reduce_max_rel_err"] = round(max(
+        float(jnp.max(jnp.abs(lossy[k] - exact[k])
+                      / (jnp.abs(exact[k]) + 1e-6))) for k in exact), 5)
+    return {"fp8_step": out}
+
+
 def _bench_gpt_moe():
     """GPT with every-other-block MoE (8 experts, dense mesh —
     single-chip expert compute): the expert-parallel surface's
@@ -1794,6 +1929,7 @@ def _sections_full(ctx: dict, rec) -> list:
         ("ddp_bucket_overlap", 300, _bench_ddp_bucket_overlap),
         ("pp_zero_bubble", 300, _bench_pp_zero_bubble),
         ("zero_sharded_step", 300, _bench_zero_sharded),
+        ("fp8_step", 300, _bench_fp8_step),
         ("monitor", 120, lambda: _monitor_extras(rec)),
     ]
     return sections
@@ -1803,7 +1939,7 @@ def _sections_full(ctx: dict, rec) -> list:
 # forcibly timed out (the probe) — asserted after the run
 SMOKE_EXPECTED = ("smoke_mlp_amp", "smoke_fused_adam",
                   "smoke_noop_dispatch", "tp_overlap", "ddp_bucket_overlap",
-                  "pp_zero_bubble", "zero_sharded_step",
+                  "pp_zero_bubble", "zero_sharded_step", "fp8_step",
                   "smoke_timeout_probe", "monitor")
 
 
@@ -1895,6 +2031,9 @@ def _sections_smoke(ctx: dict, rec) -> list:
         # same code in smoke and full: the residency split is measured
         # on the host data mesh either way
         ("zero_sharded_step", 240, _bench_zero_sharded),
+        # same code in smoke and full: ml_dtypes runs the fp8 casts for
+        # real on CPU, and the byte accounting is trace-time
+        ("fp8_step", 120, _bench_fp8_step),
         ("smoke_timeout_probe", probe_budget, timeout_probe),
         ("monitor", 60, lambda: _monitor_extras(rec)),
     ]

@@ -54,8 +54,9 @@ def test_opt_level_defaults():
 
 
 def test_invalid_opt_level():
+    # O4 became the fp8 level (amp/fp8.py); O5 is the next free slot
     with pytest.raises(RuntimeError):
-        amp.initialize(_mlp_apply, opt_level="O4")
+        amp.initialize(_mlp_apply, opt_level="O5")
 
 
 def test_initialize_enabled_false_passthrough():
