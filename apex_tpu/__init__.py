@@ -50,6 +50,7 @@ from apex_tpu import monitor  # noqa: F401
 from apex_tpu import pyprof  # noqa: F401
 from apex_tpu import checkpoint  # noqa: F401
 from apex_tpu import zero  # noqa: F401
+from apex_tpu import tune  # noqa: F401
 
 # heavier subpackages (transformer, contrib, models) import on demand:
 #   import apex_tpu.transformer / apex_tpu.contrib / apex_tpu.models

@@ -383,6 +383,59 @@ def _fp8_train_step():
     return fn, (params, fstate, x, y), mesh.axis_names
 
 
+def _flash_attention_tuned_step():
+    """A cache-resolved flash-attention fwd+bwd step: the builder
+    writes tuned block entries (both phases) into a throwaway autotune
+    cache and the step resolves its tiling from it at trace time —
+    keeping the ``autotune="cache"`` resolution path (host-side lookup,
+    monitor events, tuned grids) inside the zero-findings gate. The
+    resolved blocks differ from the heuristic defaults on purpose, so a
+    silently-dead lookup would be caught by the builder's assert."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.ops.flash_attention import flash_attention
+    from apex_tpu.tune import TuneCache, cache_key
+    from apex_tpu.tune import runtime as tune_rt
+
+    mesh, _, _ = _mesh_for()
+    b, h, s, d = 1, 2, 128, 8
+    tmp = tempfile.mkdtemp(prefix="apexlint_tune_")
+    cache = TuneCache(tmp)
+    shape = {"b": b, "h": h, "sq": s, "sk": s, "d": d, "itemsize": 4}
+    flags = {"causal": True, "bias": False, "dropout": False,
+             "segments": False}
+    for kern in ("flash_attention_fwd", "flash_attention_bwd"):
+        cache.put(cache_key(kern, shape, "float32", flags),
+                  {"block_q": 64, "block_k": 64})
+
+    def run(q, k, v):
+        # block resolution is trace-time host work: point the lookup at
+        # the builder's cache for the duration of the trace, restore
+        # after (the gate runs inside the user's process)
+        with tune_rt.override_cache_dir(tmp):
+            cfg = tune_rt.resolve("flash_attention_fwd", shape,
+                                  "float32", flags, policy="cache")
+            assert cfg == {"block_q": 64, "block_k": 64}, \
+                f"lint entrypoint cache did not resolve: {cfg}"
+
+            def loss(q, k, v):
+                return jnp.sum(flash_attention(
+                    q, k, v, causal=True, interpret=True) ** 2)
+
+            return jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    # abstract-trace-only entrypoint; the toy q/k/v double as the
+    # returned grads, so donation would alias inputs the checker still
+    # reads (APX007's conscious-opt-out form)
+    fn = jax.jit(run, donate_argnums=())
+    q = jnp.zeros((b, h, s, d), jnp.float32)
+    k = jnp.zeros((b, h, s, d), jnp.float32)
+    v = jnp.zeros((b, h, s, d), jnp.float32)
+    return fn, (q, k, v), mesh.axis_names
+
+
 def _fused_lm_head_ce():
     """Vocab-parallel fused LM-head CE: the pmax/psum trio over the
     tensor axis, plus the Pallas kernels in interpret mode."""
@@ -419,4 +472,5 @@ register_entrypoint("pp_zero_bubble_interleaved_step",
                     _pp_zero_bubble_interleaved_step)
 register_entrypoint("zero3_train_step", _zero3_train_step)
 register_entrypoint("fp8_train_step", _fp8_train_step)
+register_entrypoint("flash_attention_tuned_step", _flash_attention_tuned_step)
 register_entrypoint("fused_lm_head_ce", _fused_lm_head_ce)

@@ -89,6 +89,22 @@ def timer_event(name: str, seconds: float, **extra):
         rec.timer_event(name, seconds, **extra)
 
 
+def tune_event(kernel: str, key: str, *, hit: bool, source: str,
+               config=None):
+    """One autotuner cache resolution (``apex_tpu.tune.runtime``):
+    bumps the ``tune/cache_hit``/``tune/cache_miss`` counter, sets the
+    ``tune/cache_hit`` gauge (1.0 on a hit — last-resolution-wins, the
+    cheap thing a bench section asserts), and records a typed ``tune``
+    event carrying the full cache key and the resolved config."""
+    rec = _state.recorder
+    if rec is None:
+        return
+    rec.counter("tune/cache_hit" if hit else "tune/cache_miss")
+    rec.gauge("tune/cache_hit", 1.0 if hit else 0.0)
+    rec.emit("tune", kernel, key, hit=bool(hit), source=source,
+             config=config)
+
+
 # -- traced hooks (insert a debug callback when enabled) ---------------------
 #
 # The callback targets resolve the recorder at FIRE time, not at trace

@@ -931,7 +931,8 @@ def flash_attention(q, k, v, segment_ids_q=None, segment_ids_kv=None,
                     block_k: Optional[int] = None,
                     block_q_bwd: Optional[int] = None,
                     block_k_bwd: Optional[int] = None,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    autotune: Optional[str] = None):
     """Fused attention. Returns [b, h, sq, d].
 
     ``segment_ids_*``: packed-varlen support (FMHA cu_seqlens analog) —
@@ -953,6 +954,16 @@ def flash_attention(q, k, v, segment_ids_q=None, segment_ids_kv=None,
     ``block_q_bwd``/``block_k_bwd`` tile the backward kernels and default
     to the phase-tuned values (module docstring).
 
+    ``autotune``: block-resolution policy for knobs left at ``None`` —
+    ``"cache"`` (default; also via ``$APEX_TPU_AUTOTUNE``) consults the
+    persistent per-device tuned-block cache
+    (``python -m apex_tpu.ops tune``, docs/perf.md §autotuning) and
+    falls back to the heuristic defaults on a miss; ``"off"`` skips the
+    lookup entirely (bit-for-bit the heuristic defaults); ``"online"``
+    sweeps-and-caches on first miss. Explicitly-passed blocks always
+    win. The forward and backward resolve INDEPENDENTLY: a cache that
+    holds backward blocks retires the inheritance warning below.
+
     .. warning:: explicitly-passed forward blocks silently govern the
        backward too: when you set ``block_q``/``block_k`` but not
        ``block_q_bwd``/``block_k_bwd``, the backward inherits your
@@ -964,13 +975,48 @@ def flash_attention(q, k, v, segment_ids_q=None, segment_ids_kv=None,
        ``block_q_bwd=None``-equivalent explicitly:
        ``flash_attention(..., block_q=1024, block_k=1024,
        block_q_bwd=512, block_k_bwd=512)`` (or whatever the module
-       docstring's phase table says for your shape). A one-time
-       ``UserWarning`` flags the inheritance so the behavior is never
-       silent.
+       docstring's phase table says for your shape), or let the tuned
+       cache supply them — a backward cache hit takes precedence over
+       the inheritance, silently. A one-time ``UserWarning`` flags the
+       inheritance so the behavior is never silent otherwise.
     """
     if dropout_rate >= 1.0 or dropout_rate < 0.0:
         raise ValueError(f"dropout_rate must be in [0, 1), got {dropout_rate}")
     explicit_fwd_blocks = block_q is not None or block_k is not None
+    if (block_q is None and block_k is None) or \
+            (block_q_bwd is None and block_k_bwd is None):
+        from apex_tpu.tune import runtime as _tune_rt
+        policy = _tune_rt.resolve_policy(autotune)
+        if policy != "off":
+            shape = {"b": q.shape[0], "h": q.shape[1], "sq": q.shape[2],
+                     "sk": k.shape[2], "d": q.shape[3],
+                     "itemsize": q.dtype.itemsize}
+            flags = {"causal": causal, "bias": bias is not None,
+                     "dropout": dropout_rate > 0.0,
+                     "segments": segment_ids_q is not None}
+            interp = _resolve_interpret(interpret)
+            if block_q is None and block_k is None:
+                cfg = _tune_rt.resolve("flash_attention_fwd", shape,
+                                       q.dtype.name, flags, policy=policy,
+                                       interpret=interp)
+                if cfg is not None:
+                    block_q, block_k = cfg["block_q"], cfg["block_k"]
+            if block_q_bwd is None and block_k_bwd is None:
+                cfg = _tune_rt.resolve("flash_attention_bwd", shape,
+                                       q.dtype.name, flags, policy=policy,
+                                       interpret=interp)
+                if cfg is not None:
+                    # a cache-resolved backward retires the
+                    # forward-blocks-govern-backward inheritance: with
+                    # both bwd blocks set here the warning branch below
+                    # is never entered, so it neither fires nor
+                    # consumes its once-key (tested)
+                    block_q_bwd = cfg["block_q"]
+                    block_k_bwd = cfg["block_k"]
+    elif autotune is not None:
+        # fully-pinned call sites still get policy-string validation
+        from apex_tpu.tune import runtime as _tune_rt
+        _tune_rt.resolve_policy(autotune)
     if block_q is None or block_k is None:
         # bias + dropout together exceed VMEM at 1024 blocks (see module
         # docstring); everything else is fastest at 1024 in the FORWARD,

@@ -67,8 +67,9 @@ _NEG_INF = -1e30
 # (r4 regression of the long-seq-remat path, caught by the s=8192
 # re-verify). v5e VMEM is 128 MB; 64 MB keeps the measured-fastest
 # tiles valid in every shipping context with headroom for the
-# compiler's own buffers.
-_VMEM_LIMIT = 64 * 1024 * 1024
+# compiler's own buffers. The constant (and the resident-set model the
+# autotuner prunes with) lives in tune/vmem.py — one shared envelope.
+from apex_tpu.tune.vmem import LM_HEAD_VMEM_LIMIT as _VMEM_LIMIT
 
 
 def _compiler_params():
@@ -87,14 +88,16 @@ def _pow2_at_most(x: int) -> int:
 
 
 def _pick_blocks(n: int, v: int, h: int, block_t: Optional[int],
-                 block_v: Optional[int]):
+                 block_v: Optional[int], itemsize: int = 2):
     """Block sizes fitting Mosaic's ~16 MB scoped-VMEM budget.
 
     The backward's resident set is dominated by the fp32 ``dE`` block
     (block_v*h*4) plus the double-buffered bf16 E/x blocks, the fp32
     logits tile (block_t*block_v*4) and the dx tile — ~22 MB at the
     defaults (bt=512, bv=2048, h=1024), which is why the kernels carry a
-    raised ``vmem_limit_bytes``. v5e sweeps at the GPT bench shape
+    raised ``vmem_limit_bytes``. (That budget math is promoted into
+    ``apex_tpu.tune.vmem.vmem_estimate`` — shared with the autotuner's
+    config pruning.) v5e sweeps at the GPT bench shape
     (n=8192, V=32k, h=1024), full-step ms: interleaved A/B gave
     (512,2048) 102.5 < (256,1024) 105.0 on the same clock; an earlier
     sweep ranked (256,1024) 97.1 < (256,512) 98.9 < (1024,512) 101.1 ~
@@ -102,12 +105,57 @@ def _pick_blocks(n: int, v: int, h: int, block_t: Optional[int],
     drift between runs — only interleaved comparisons rank reliably).
     A big vocab block halves the dx-partial count (the HBM reduce after
     the kernel); the token block trades logits-tile VMEM against x
-    re-fetches."""
+    re-fetches.
+
+    A HALF-explicit pair (exactly one of ``block_t``/``block_v``
+    passed) used to silently inherit the other knob's default and could
+    exceed the kernel's raised VMEM limit — the estimate is now checked
+    and the defaulted knob shrunk to the nearest legal value (the
+    explicit knob only as a last resort), with a one-time warning
+    naming the legal pair. Fully-explicit pairs are the user's
+    responsibility (unchanged), and the both-``None`` heuristic is
+    bit-for-bit what it always was."""
+    from apex_tpu.tune import vmem
+    explicit_t, explicit_v = block_t is not None, block_v is not None
     if block_t is None:
         block_t = min(512, _ceil_to(n, 8))
     if block_v is None:
         cap = max(128, (8 * 1024 * 1024) // (4 * h))
         block_v = min(_pow2_at_most(cap), _ceil_to(v, 128))
+    if explicit_t != explicit_v:
+        est = vmem.vmem_estimate("lm_head_ce", block_t=block_t,
+                                 block_v=block_v, h=h, itemsize=itemsize)
+        if est > _VMEM_LIMIT:
+            bt, bv = block_t, block_v
+            # shrink the DEFAULTED knob first — the explicit one is the
+            # user's stated intent — then the explicit one if the
+            # explicit choice alone cannot fit
+            while vmem.vmem_estimate(
+                    "lm_head_ce", block_t=bt, block_v=bv, h=h,
+                    itemsize=itemsize) > _VMEM_LIMIT:
+                if explicit_t and bv > 128:
+                    bv //= 2
+                elif explicit_v and bt > 8:
+                    bt = max(8, bt // 2)
+                elif bv > 128:
+                    bv //= 2
+                elif bt > 8:
+                    bt = max(8, bt // 2)
+                else:
+                    break
+            bv = max(128, bv)
+            from apex_tpu.utils.parity import warn_inert_once
+            warn_inert_once(
+                f"fused_lm_head_cross_entropy: explicit "
+                f"{'block_t' if explicit_t else 'block_v'}="
+                f"{block_t if explicit_t else block_v} with the default "
+                f"{'block_v' if explicit_t else 'block_t'} estimates "
+                f"{est / 2**20:.1f} MB resident VMEM, over the "
+                f"{_VMEM_LIMIT / 2**20:.0f} MB kernel limit; using the "
+                f"nearest legal pair (block_t={bt}, block_v={bv}). Pass "
+                "both knobs explicitly to pin an exact tiling.",
+                key="lm_head_ce.half_explicit_over_budget")
+            block_t, block_v = bt, bv
     return block_t, block_v
 
 
@@ -303,7 +351,8 @@ def fused_lm_head_cross_entropy(
         x, embedding, targets, label_smoothing: float = 0.0,
         axis_name: Optional[str] = None,
         block_t: Optional[int] = None, block_v: Optional[int] = None,
-        interpret: Optional[bool] = None):
+        interpret: Optional[bool] = None,
+        autotune: Optional[str] = None):
     """Per-token cross entropy of ``x @ embedding.T`` without ever
     materializing the logits.
 
@@ -320,6 +369,12 @@ def fused_lm_head_cross_entropy(
         single shard).
       block_t / block_v: token/vocab tile sizes (v5e-tuned defaults).
       interpret: force Pallas interpret mode (defaults to True off-TPU).
+      autotune: block-resolution policy when both tile knobs are
+        ``None`` — ``"cache"`` (default; ``$APEX_TPU_AUTOTUNE``)
+        resolves from the persistent tuned-block cache
+        (``python -m apex_tpu.ops tune``), ``"off"`` pins the heuristic
+        defaults bit-for-bit, ``"online"`` sweeps-and-caches on first
+        miss. Explicit blocks always win.
 
     Returns: fp32 per-token loss with ``x``'s leading shape.
     """
@@ -333,7 +388,23 @@ def fused_lm_head_cross_entropy(
     tgt = targets.reshape(n).astype(jnp.int32)
     if axis_name is not None and ps.axis_size_if_bound(axis_name) > 1:
         tgt = tgt - ps._axis_rank(axis_name) * v_local
-    block_t, block_v = _pick_blocks(n, v_local, h, block_t, block_v)
+    if block_t is None and block_v is None:
+        from apex_tpu.tune import runtime as _tune_rt
+        policy = _tune_rt.resolve_policy(autotune)
+        if policy != "off":
+            cfg = _tune_rt.resolve(
+                "lm_head_ce",
+                {"n": n, "v": v_local, "h": h,
+                 "itemsize": x.dtype.itemsize},
+                x.dtype.name, {"smoothing": label_smoothing > 0.0},
+                policy=policy, interpret=_resolve_interpret(interpret))
+            if cfg is not None:
+                block_t, block_v = cfg["block_t"], cfg["block_v"]
+    elif autotune is not None:
+        from apex_tpu.tune import runtime as _tune_rt
+        _tune_rt.resolve_policy(autotune)
+    block_t, block_v = _pick_blocks(n, v_local, h, block_t, block_v,
+                                    itemsize=x.dtype.itemsize)
     n_pad = _ceil_to(n, block_t)
     if n_pad != n:
         xf = jnp.pad(xf, ((0, n_pad - n), (0, 0)))

@@ -1,0 +1,41 @@
+"""apex_tpu.tune — measure-and-cache Pallas kernel autotuning.
+
+The reference hard-codes launch geometry per CUDA architecture
+(``csrc/`` warp/block constants baked per SM); our Pallas kernels expose
+block knobs instead (``flash_attention``'s ``block_q/block_k`` +
+``block_q_bwd/block_k_bwd``, ``fused_lm_head_cross_entropy``'s
+``block_t/block_v``). This package replaces the hand-tuning scripts
+with one measure-and-cache autotuner:
+
+- :mod:`~apex_tpu.tune.vmem` — the shared VMEM-envelope model
+  (promoted from ``lm_head_ce._pick_blocks`` + the flash tile-cost
+  accounting) that prunes illegal configs before compile;
+- :mod:`~apex_tpu.tune.space` — legal block grids from static
+  shape/dtype;
+- :mod:`~apex_tpu.tune.harness` — compile-excluded median-of-k sweep
+  with per-config timeout and an injectable timer (tests run a
+  deterministic fake clock on CPU);
+- :mod:`~apex_tpu.tune.cache` — persistent atomic-write JSON keyed by
+  ``(device_kind, kernel, shape-bucket, dtype, flags)``; corrupt/stale
+  entries degrade to heuristics;
+- :mod:`~apex_tpu.tune.runtime` — the lookup the kernels call when
+  their block knobs are ``None`` (``autotune="off"/"cache"/"online"``).
+
+Offline entry point::
+
+    python -m apex_tpu.ops tune --kernel flash_attention \\
+        --shapes "b=8,h=16,s=1024,d=64,dtype=bf16,causal=1"
+
+Telemetry: every runtime resolution lands as monitor
+``tune/cache_hit``/``tune/cache_miss`` counters, a ``tune/cache_hit``
+gauge, and a typed ``tune`` event; sweep measurements ride the
+``tune/sweep/<kernel>`` timer path. Docs: docs/perf.md §autotuning.
+"""
+
+from apex_tpu.tune.cache import (  # noqa: F401
+    TuneCache, cache_key, default_cache_dir, shape_bucket)
+from apex_tpu.tune.harness import sweep, wall_timer  # noqa: F401
+from apex_tpu.tune.runtime import (  # noqa: F401
+    invalidate, override_cache_dir, resolve, resolve_policy)
+from apex_tpu.tune.space import config_space  # noqa: F401
+from apex_tpu.tune.vmem import budget_for, fits, vmem_estimate  # noqa: F401

@@ -1,0 +1,191 @@
+"""Persistent per-device autotune cache.
+
+One JSON file per ``device_kind`` under the cache directory
+(``$APEX_TPU_TUNE_CACHE``, an explicit argument, or
+``~/.cache/apex_tpu/tune``), schema::
+
+    {"schema": 1, "device_kind": "TPU v5e",
+     "entries": {"<key>": {"config": {...}, "ms": 1.17, "swept": 9,
+                            "ts": 1722600000}}}
+
+Keys are ``kernel|shape-bucket|dtype|flags`` strings
+(:func:`cache_key`). Shapes are BUCKETED — batch*heads and sequence
+extents round up to powers of two — so one tuned entry serves the whole
+bucket: block choice is governed by tile geometry (sequence extent,
+head/hidden dim, dtype, feature flags), not by the exact batch size,
+and bucketing keeps the cache (and the offline sweep matrix) small.
+Inside the kernels blocks still clamp to the actual sequence, so a
+bucket-resolved block is always legal for the concrete shape.
+
+Robustness contract (ISSUE 8 tentpole c): corrupt JSON, an unknown
+schema version, or a ``device_kind`` that does not match the running
+device all degrade to heuristic defaults — a lookup returns ``None``
+(gauged as a cache miss by the runtime layer), never raises. Writes are
+atomic: serialize to a ``.tmp.<pid>`` sibling, ``os.replace`` onto the
+canonical name — a crash mid-write leaves either the old file or the
+new one, and a stray partial tmp file is never read (loads open only
+the canonical name).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Optional
+
+SCHEMA = 1
+ENV_CACHE_DIR = "APEX_TPU_TUNE_CACHE"
+
+# the exact config-dict key set per kernel — lookup() rejects entries
+# whose names drifted (hand-edited file, schema evolution) so a resolved
+# config can be indexed by the kernels without a KeyError
+CONFIG_KEYS = {"flash_attention_fwd": frozenset(("block_q", "block_k")),
+               "flash_attention_bwd": frozenset(("block_q", "block_k")),
+               "lm_head_ce": frozenset(("block_t", "block_v"))}
+
+
+def _pow2_ceil(x: int) -> int:
+    return 1 << max(0, (int(x) - 1).bit_length())
+
+
+def default_cache_dir() -> str:
+    env = os.environ.get(ENV_CACHE_DIR)
+    if env:
+        return env
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache")
+    return os.path.join(base, "apex_tpu", "tune")
+
+
+def current_device_kind() -> str:
+    """The running backend's device kind (``"TPU v5e"``, ``"cpu"``,
+    ...). Imported lazily — cache/key code must work jax-free."""
+    try:
+        import jax
+        return str(jax.devices()[0].device_kind)
+    except Exception:
+        return "unknown"
+
+
+def _flags_str(flags: Optional[dict]) -> str:
+    active = sorted(k for k, v in (flags or {}).items() if v)
+    return "+".join(active) if active else "plain"
+
+
+def shape_bucket(kernel: str, shape: dict) -> str:
+    """The bucketed-shape component of a cache key."""
+    if kernel in ("flash_attention_fwd", "flash_attention_bwd"):
+        bh = _pow2_ceil(shape.get("b", 1) * shape.get("h", 1))
+        return (f"bh{bh}_sq{_pow2_ceil(shape['sq'])}"
+                f"_sk{_pow2_ceil(shape['sk'])}_d{shape['d']}")
+    if kernel == "lm_head_ce":
+        return (f"n{_pow2_ceil(shape['n'])}_v{_pow2_ceil(shape['v'])}"
+                f"_h{shape['h']}")
+    raise ValueError(f"unknown kernel {kernel!r}")
+
+
+def cache_key(kernel: str, shape: dict, dtype: str,
+              flags: Optional[dict] = None) -> str:
+    return "|".join((kernel, shape_bucket(kernel, shape), str(dtype),
+                     _flags_str(flags)))
+
+
+def _kind_filename(device_kind: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]+", "_", device_kind) + ".json"
+
+
+class TuneCache:
+    """mtime-checked view over one device-kind cache file.
+
+    Lookups stat the file and reload only when (mtime_ns, size) moved,
+    so a per-kernel-call lookup costs one ``os.stat``. All failure
+    modes return ``None``/no-op; the runtime layer turns them into
+    gauged heuristic fallbacks.
+    """
+
+    def __init__(self, directory: Optional[str] = None,
+                 device_kind: Optional[str] = None):
+        self.directory = directory or default_cache_dir()
+        self.device_kind = device_kind or current_device_kind()
+        self.path = os.path.join(self.directory,
+                                 _kind_filename(self.device_kind))
+        self._entries: dict = {}
+        self._stat = None      # (mtime_ns, size) of the loaded file
+        self._valid = False
+
+    # -- load ---------------------------------------------------------------
+    def _refresh(self):
+        try:
+            st = os.stat(self.path)
+            sig = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            self._entries, self._stat, self._valid = {}, None, False
+            return
+        if sig == self._stat:
+            return
+        self._stat = sig
+        self._entries, self._valid = {}, False
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return                      # corrupt/unreadable: stay empty
+        if not isinstance(data, dict) or data.get("schema") != SCHEMA:
+            return                      # unknown schema: stay empty
+        if data.get("device_kind") != self.device_kind:
+            return                      # foreign device's entries: ignore
+        entries = data.get("entries")
+        if isinstance(entries, dict):
+            self._entries = entries
+            self._valid = True
+
+    def lookup(self, key: str) -> Optional[dict]:
+        """The tuned config for ``key``, or None. Never raises."""
+        try:
+            self._refresh()
+            ent = self._entries.get(key)
+            if not isinstance(ent, dict):
+                return None
+            cfg = ent.get("config")
+            want = CONFIG_KEYS.get(key.split("|", 1)[0])
+            if (isinstance(cfg, dict) and cfg
+                    and (want is None or set(cfg) == want)
+                    and all(isinstance(v, int) and v > 0
+                            for v in cfg.values())):
+                return dict(cfg)
+            return None
+        except Exception:
+            return None
+
+    def entries(self) -> dict:
+        self._refresh()
+        return {k: dict(v) for k, v in self._entries.items()}
+
+    # -- store --------------------------------------------------------------
+    def put(self, key: str, config: dict, *, ms: Optional[float] = None,
+            swept: Optional[int] = None) -> None:
+        """Merge one entry and atomically rewrite the cache file."""
+        self._refresh()
+        entries = dict(self._entries) if self._valid else {}
+        row = {"config": {k: int(v) for k, v in config.items()},
+               "ts": int(time.time())}
+        if ms is not None:
+            row["ms"] = round(float(ms), 6)
+        if swept is not None:
+            row["swept"] = int(swept)
+        entries[key] = row
+        self._write(entries)
+
+    def _write(self, entries: dict) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        data = {"schema": SCHEMA, "device_kind": self.device_kind,
+                "entries": entries}
+        tmp = self.path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._stat = None               # force reload on next lookup

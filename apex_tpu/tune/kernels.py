@@ -1,0 +1,249 @@
+"""Kernel-specific sweep builders: the bridge between the generic
+harness and the two tunable Pallas kernel families.
+
+Everything here builds synthetic operands from static shape/dtype
+(numpy RNG — no PRNG key plumbing, and it works at trace time for the
+``"online"`` policy: the sweep's own jits execute eagerly on concrete
+arrays). The flash backward is tuned INDEPENDENTLY of the forward: its
+runner times only the vjp closure (the forward runs once, untimed, to
+produce residuals), with the forward pinned at its own resolution so a
+backward candidate never perturbs the forward measurement.
+
+jax/ops imports are all lazy — this module sits below ops in the import
+graph (ops imports tune.runtime) and must not close the cycle.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from apex_tpu.tune import harness, space
+from apex_tpu.tune.cache import TuneCache, cache_key
+
+_DTYPES = {"bf16": "bfloat16", "bfloat16": "bfloat16",
+           "fp32": "float32", "float32": "float32",
+           "f32": "float32", "fp16": "float16", "float16": "float16"}
+
+# the offline default sweep matrix: the bench model shapes (docs/perf.md)
+DEFAULT_SHAPES = {
+    "flash_attention": [
+        dict(b=8, h=16, sq=1024, sk=1024, d=64, dtype="bfloat16",
+             causal=True),
+        dict(b=32, h=12, sq=512, sk=512, d=64, dtype="bfloat16",
+             causal=False),
+    ],
+    "lm_head_ce": [
+        dict(n=8192, v=32768, h=1024, dtype="bfloat16"),
+        dict(n=16384, v=30522, h=768, dtype="bfloat16"),
+    ],
+}
+
+
+def _np_dtype(dtype: str):
+    import jax.numpy as jnp
+    return jnp.dtype(_DTYPES.get(dtype, dtype))
+
+
+def parse_shape_spec(kernel: str, spec: str) -> dict:
+    """``"b=8,h=16,s=1024,d=64,dtype=bf16,causal=1"`` -> shape dict.
+    ``s=`` sets both sq and sk for flash. Unknown keys raise."""
+    flash = kernel.startswith("flash_attention")
+    known = ({"b", "h", "s", "sq", "sk", "d", "dtype", "causal", "bias",
+              "dropout", "segments"} if flash
+             else {"n", "v", "h", "dtype", "smoothing"})
+    out: dict = {"dtype": "bfloat16"}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad shape field {part!r} (want key=value)")
+        k, val = part.split("=", 1)
+        k = k.strip()
+        if k not in known:
+            raise ValueError(f"unknown shape field {k!r} for {kernel} "
+                             f"(known: {sorted(known)})")
+        if k == "dtype":
+            raw = val.strip()
+            dt = _DTYPES.get(raw, raw)
+            try:
+                _np_dtype(dt)
+            except Exception:
+                raise ValueError(f"unknown dtype {raw!r} (known aliases: "
+                                 f"{sorted(_DTYPES)})")
+            out[k] = dt
+        elif k in ("causal", "bias", "dropout", "segments", "smoothing"):
+            out[k] = val.strip() not in ("0", "false", "False", "")
+        elif k == "s":
+            out["sq"] = out["sk"] = int(val)
+        else:
+            out[k] = int(val)
+    if flash:
+        out.setdefault("b", 1)
+        out.setdefault("h", 1)
+        for req in ("sq", "sk", "d"):
+            if req not in out:
+                raise ValueError(f"flash shape spec needs {req} (or s)")
+    else:
+        for req in ("n", "v", "h"):
+            if req not in out:
+                raise ValueError(f"lm_head_ce shape spec needs {req}")
+    return out
+
+
+def split_shape(kernel: str, spec: dict):
+    """(shape, dtype, flags) triplet in the cache-key vocabulary."""
+    spec = dict(spec)
+    raw = spec.pop("dtype", "bfloat16")
+    dtype = _DTYPES.get(raw, raw)
+    try:
+        _np_dtype(dtype)
+    except Exception:
+        raise ValueError(
+            f"unknown dtype {raw!r} (known aliases: {sorted(_DTYPES)})")
+    if kernel.startswith("flash_attention"):
+        flags = {k: bool(spec.pop(k, False))
+                 for k in ("causal", "bias", "dropout", "segments")}
+    else:
+        flags = {"smoothing": bool(spec.pop("smoothing", False))}
+    spec["itemsize"] = _np_dtype(dtype).itemsize
+    return spec, dtype, flags
+
+
+def _flash_operands(shape: dict, dtype: str, flags: dict):
+    import jax.numpy as jnp
+    import numpy as np
+    rng = np.random.RandomState(0)
+    b, h = shape.get("b", 1), shape.get("h", 1)
+    sq, sk, d = shape["sq"], shape["sk"], shape["d"]
+    dt = _np_dtype(dtype)
+    q = jnp.asarray(rng.randn(b, h, sq, d) * 0.1, dt)
+    k = jnp.asarray(rng.randn(b, h, sk, d) * 0.1, dt)
+    v = jnp.asarray(rng.randn(b, h, sk, d) * 0.1, dt)
+    kw = dict(causal=bool(flags.get("causal")), autotune="off")
+    if flags.get("bias"):
+        kw["bias"] = jnp.asarray(rng.randn(1, 1, sq, sk) * 0.2, jnp.float32)
+    if flags.get("dropout"):
+        kw.update(dropout_rate=0.1, dropout_seed=17)
+    if flags.get("segments"):
+        import numpy as _np
+        sid = _np.zeros((b, sq), _np.int32)
+        sid[:, sq // 2:] = 1
+        kw["segment_ids_q"] = jnp.asarray(sid)
+        if sk != sq:
+            sidk = _np.zeros((b, sk), _np.int32)
+            sidk[:, sk // 2:] = 1
+            kw["segment_ids_kv"] = jnp.asarray(sidk)
+    return (q, k, v), kw
+
+
+def build_flash_fwd(shape: dict, dtype: str, flags: dict, *,
+                    interpret: Optional[bool] = None):
+    """``build(config)`` for the harness: a jitted forward-only call at
+    the candidate tiling (backward pinned too, so the traced program is
+    complete and the warning path stays quiet)."""
+    import jax
+    (q, k, v), kw = _flash_operands(shape, dtype, flags)
+
+    def build(config):
+        from apex_tpu.ops.flash_attention import flash_attention
+        fn = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, block_q=config["block_q"], block_k=config["block_k"],
+            block_q_bwd=config["block_q"], block_k_bwd=config["block_k"],
+            interpret=interpret, **kw))
+        return lambda: jax.block_until_ready(fn(q, k, v))
+    return build
+
+
+def build_flash_bwd(shape: dict, dtype: str, flags: dict, *,
+                    interpret: Optional[bool] = None):
+    """``build(config)``: times ONLY the backward — ``jax.vjp`` runs
+    the forward once per build (untimed, heuristic-default tiling) and
+    the timed callable applies the jitted vjp closure."""
+    import jax
+    import jax.numpy as jnp
+    (q, k, v), kw = _flash_operands(shape, dtype, flags)
+
+    def build(config):
+        from apex_tpu.ops.flash_attention import flash_attention
+
+        def f(q, k, v):
+            return flash_attention(
+                q, k, v, block_q_bwd=config["block_q"],
+                block_k_bwd=config["block_k"], interpret=interpret, **kw)
+
+        out, vjp = jax.vjp(f, q, k, v)
+        do = jnp.ones_like(out)
+        vjp_j = jax.jit(vjp)
+        return lambda: jax.block_until_ready(vjp_j(do))
+    return build
+
+
+def build_lm_head_ce(shape: dict, dtype: str, flags: dict, *,
+                     interpret: Optional[bool] = None):
+    """``build(config)``: jitted fwd+bwd of the fused loss at the
+    candidate (block_t, block_v) — the two phases share the knobs, so
+    the sweep times them together (what a train step pays)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    rng = np.random.RandomState(0)
+    n, v_, h = shape["n"], shape["v"], shape["h"]
+    dt = _np_dtype(dtype)
+    x = jnp.asarray(rng.randn(n, h) * 0.05, dt)
+    emb = jnp.asarray(rng.randn(v_, h) * 0.05, dt)
+    tgt = jnp.asarray(rng.randint(0, v_, (n,)), jnp.int32)
+    smoothing = 0.1 if flags.get("smoothing") else 0.0
+
+    def build(config):
+        from apex_tpu.ops.lm_head_ce import fused_lm_head_cross_entropy
+
+        def loss(x, emb):
+            return jnp.mean(fused_lm_head_cross_entropy(
+                x, emb, tgt, label_smoothing=smoothing,
+                block_t=config["block_t"], block_v=config["block_v"],
+                interpret=interpret, autotune="off"))
+
+        fn = jax.jit(jax.value_and_grad(loss, argnums=(0, 1)))
+        return lambda: jax.block_until_ready(fn(x, emb))
+    return build
+
+
+_BUILDERS = {"flash_attention_fwd": build_flash_fwd,
+             "flash_attention_bwd": build_flash_bwd,
+             "lm_head_ce": build_lm_head_ce}
+
+
+def tune_one(kernel: str, shape: dict, dtype: str, flags: dict, *,
+             interpret: Optional[bool] = None, median_of: int = 5,
+             warmup: int = 1, config_timeout_s: Optional[float] = 120.0,
+             timer=None) -> dict:
+    """Sweep one (kernel, shape bucket): enumerate the legal config
+    space, measure, return the harness result dict."""
+    candidates = space.config_space(kernel, shape, flags)
+    build = _BUILDERS[kernel](shape, dtype, flags, interpret=interpret)
+    return harness.sweep(candidates, build, timer=timer,
+                         median_of=median_of, warmup=warmup,
+                         config_timeout_s=config_timeout_s, label=kernel)
+
+
+def tune_and_store(kernel: str, spec: dict, cache: TuneCache, *,
+                   interpret: Optional[bool] = None, median_of: int = 5,
+                   warmup: int = 1, config_timeout_s: Optional[float] = 120.0,
+                   timer=None) -> dict:
+    """Sweep + persist: the offline CLI's unit of work. Returns
+    ``{key, kernel, best, best_s, n_candidates, n_failed}``."""
+    shape, dtype, flags = split_shape(kernel, spec)
+    result = tune_one(kernel, shape, dtype, flags, interpret=interpret,
+                      median_of=median_of, warmup=warmup,
+                      config_timeout_s=config_timeout_s, timer=timer)
+    key = cache_key(kernel, shape, dtype, flags)
+    if result["best"] is not None:
+        cache.put(key, result["best"],
+                  ms=(result["best_s"] or 0.0) * 1e3,
+                  swept=len(result["results"]))
+    return {"key": key, "kernel": kernel, "best": result["best"],
+            "best_s": result["best_s"],
+            "n_candidates": len(result["results"]) + len(result["failed"]),
+            "n_failed": len(result["failed"]),
+            "results": result["results"], "failed": result["failed"]}

@@ -1,0 +1,168 @@
+"""Runtime block resolution: the piece the kernels call.
+
+``flash_attention`` and ``fused_lm_head_cross_entropy`` call
+:func:`resolve` when their block knobs are left at ``None``. Resolution
+order (tentpole d):
+
+    explicit user blocks  >  tuned cache entry  >  heuristic default
+
+with an ``autotune=`` policy:
+
+- ``"off"``    — no lookup at all; bit-for-bit today's heuristics
+  (asserted jaxpr-identical in tests);
+- ``"cache"``  — the default: use a tuned entry when one exists for
+  this (device_kind, kernel, shape-bucket, dtype, flags), fall back to
+  the heuristic otherwise. A miss costs one ``os.stat``.
+- ``"online"`` — tune-on-first-miss: a miss triggers an in-process
+  sweep over the legal config space on synthetic operands of the same
+  shape/dtype, stores the winner, and uses it. First call at a new
+  bucket pays the whole sweep (seconds to minutes on hardware) — see
+  docs/perf.md for when that is safe.
+
+Every resolution emits monitor telemetry (``tune/cache_hit`` /
+``tune/cache_miss`` counters + the ``tune/cache_hit`` gauge + a typed
+``tune`` event) so tests and the bench can assert cache behavior
+without reaching into the resolver. ``"off"`` emits nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+from apex_tpu.monitor import hooks
+from apex_tpu.tune.cache import TuneCache, cache_key
+
+ENV_POLICY = "APEX_TPU_AUTOTUNE"
+POLICIES = ("off", "cache", "online")
+
+_caches: dict = {}          # (dir, device_kind) -> TuneCache
+_device_kind: Optional[str] = None     # memo — jax.devices() is not free
+
+
+def resolve_policy(autotune: Optional[str]) -> str:
+    """Explicit argument > $APEX_TPU_AUTOTUNE > "cache"."""
+    policy = autotune if autotune is not None else \
+        os.environ.get(ENV_POLICY, "cache")
+    if policy not in POLICIES:
+        raise ValueError(
+            f"autotune policy must be one of {POLICIES}, got {policy!r}")
+    return policy
+
+
+def _cache_for(cache_dir: Optional[str]) -> TuneCache:
+    global _device_kind
+    if _device_kind is None:
+        from apex_tpu.tune.cache import current_device_kind
+        _device_kind = current_device_kind()
+    from apex_tpu.tune.cache import default_cache_dir
+    directory = cache_dir or default_cache_dir()
+    key = (directory, _device_kind)
+    cached = _caches.get(key)
+    if cached is None:
+        cached = _caches[key] = TuneCache(directory=directory,
+                                          device_kind=_device_kind)
+    return cached
+
+
+def invalidate() -> None:
+    """Drop the process-level cache handles and the device-kind memo
+    (tests; after an offline sweep into a fresh directory the mtime
+    check already reloads)."""
+    global _device_kind
+    _caches.clear()
+    _device_kind = None
+
+
+@contextlib.contextmanager
+def override_cache_dir(directory: str):
+    """Point runtime resolution at ``directory`` for the duration —
+    env var + process-level memos, both restored after. The one place
+    for the save/set/invalidate/restore dance the lint entrypoint,
+    bench section and tests all need."""
+    from apex_tpu.tune.cache import ENV_CACHE_DIR
+    prev = os.environ.get(ENV_CACHE_DIR)
+    os.environ[ENV_CACHE_DIR] = directory
+    invalidate()
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(ENV_CACHE_DIR, None)
+        else:
+            os.environ[ENV_CACHE_DIR] = prev
+        invalidate()
+
+
+def _config_sane(kernel: str, cfg: dict, shape: dict, flags: dict) -> bool:
+    """Value-level screen of a cache-resolved config: Mosaic wants
+    (8, 128)-aligned tiles and the VMEM envelope must fit — a
+    hand-edited or bit-rotted entry degrades to the heuristic rather
+    than failing at compile time. Never raises."""
+    try:
+        from apex_tpu.tune import vmem
+        if any(v % 8 != 0 for v in cfg.values()):
+            return False
+        itemsize = int(shape.get("itemsize", 2))
+        if kernel in ("flash_attention_fwd", "flash_attention_bwd"):
+            return vmem.fits(kernel, block_q=cfg["block_q"],
+                             block_k=cfg["block_k"], d=shape["d"],
+                             itemsize=itemsize,
+                             bias=bool(flags.get("bias")),
+                             dropout=bool(flags.get("dropout")),
+                             segments=bool(flags.get("segments")))
+        if kernel == "lm_head_ce":
+            return vmem.fits(kernel, block_t=cfg["block_t"],
+                             block_v=cfg["block_v"], h=shape["h"],
+                             itemsize=itemsize)
+        return False
+    except Exception:
+        return False
+
+
+def resolve(kernel: str, shape: dict, dtype: str, flags: dict, *,
+            policy: str, cache_dir: Optional[str] = None,
+            interpret: bool = False) -> Optional[dict]:
+    """Tuned config for one kernel call site, or ``None`` (use the
+    heuristic). ``policy`` comes from :func:`resolve_policy`. Never
+    raises on cache trouble — a bad cache is a miss."""
+    if policy == "off":
+        return None
+    key = cache_key(kernel, shape, dtype, flags)
+    cache = _cache_for(cache_dir)
+    cfg = cache.lookup(key)
+    if cfg is not None and not _config_sane(kernel, cfg, shape, flags):
+        cfg = None                      # drifted VALUES: a miss, not a crash
+    if cfg is not None:
+        hooks.tune_event(kernel, key, hit=True, source="cache", config=cfg)
+        return cfg
+    if policy == "online":
+        cfg = _tune_online(kernel, shape, dtype, flags, cache, key,
+                           interpret=interpret)
+        hooks.tune_event(kernel, key, hit=False, source="online",
+                         config=cfg)
+        return cfg
+    hooks.tune_event(kernel, key, hit=False, source="cache", config=None)
+    return None
+
+
+def _tune_online(kernel: str, shape: dict, dtype: str, flags: dict,
+                 cache: TuneCache, key: str, *,
+                 interpret: bool) -> Optional[dict]:
+    """Tune-on-first-miss. Runs host-side on synthetic operands built
+    from the static shape/dtype (so it also works when the kernel call
+    is being traced — the sweep's own jits execute eagerly), stores the
+    winner, returns it. Any failure degrades to the heuristic."""
+    try:
+        from apex_tpu.tune import kernels as tk
+        result = tk.tune_one(kernel, shape, dtype, flags,
+                             interpret=interpret)
+        best = result.get("best")
+        if best:
+            cache.put(key, best, ms=result.get("best_s", 0) * 1e3,
+                      swept=len(result.get("results", [])))
+        return best
+    except Exception:
+        hooks.counter("tune/online_failed")
+        return None

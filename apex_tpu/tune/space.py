@@ -1,0 +1,89 @@
+"""Per-kernel config-space generation for the autotuner.
+
+Enumerates legal block grids from static shape/dtype information alone,
+pruned by the shared VMEM-envelope model (:mod:`apex_tpu.tune.vmem`)
+so illegal configs never reach a compile. The enumeration is
+deterministic: candidates come out in a fixed order (coarsest blocks
+first), which makes sweep tie-breaking reproducible.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from apex_tpu.tune import vmem
+
+# power-of-two block menu shared by both flash phases; Mosaic wants the
+# trailing dims (8, 128)-aligned and every real sweep to date has only
+# ever ranked powers of two (scripts/fa_microbench.py history)
+_FLASH_BLOCKS = (1024, 512, 256, 128)
+_CE_BLOCK_T = (1024, 512, 256, 128)
+_CE_BLOCK_V = (8192, 4096, 2048, 1024, 512, 256, 128)
+
+
+def _pow2_ceil(x: int) -> int:
+    return 1 << max(0, (int(x) - 1).bit_length())
+
+
+def _clip_menu(menu, limit: int):
+    """Menu entries no larger than the (power-of-two-rounded) limit —
+    blocks clamp to the sequence inside the kernels, so anything past
+    the padded extent is a duplicate of the clamped config."""
+    cap = _pow2_ceil(limit)
+    out = [m for m in menu if m <= cap]
+    return out or [menu[-1]]
+
+
+def flash_attention_space(*, sq: int, sk: int, d: int, itemsize: int = 2,
+                          phase: str = "fwd", bias: bool = False,
+                          dropout: bool = False,
+                          segments: bool = False) -> list[dict]:
+    """Legal ``{"block_q", "block_k"}`` candidates for one flash phase.
+
+    ``phase`` is ``"fwd"`` or ``"bwd"`` — the two are tuned
+    independently (their measured optima differ: the r5 retune landed
+    (1024, 1024) forward / (512, 512) backward at the causal GPT shape).
+    """
+    if phase not in ("fwd", "bwd"):
+        raise ValueError(f"phase must be 'fwd' or 'bwd', got {phase!r}")
+    kernel = f"flash_attention_{phase}"
+    out = []
+    for bq in _clip_menu(_FLASH_BLOCKS, sq):
+        for bk in _clip_menu(_FLASH_BLOCKS, sk):
+            if vmem.fits(kernel, block_q=bq, block_k=bk, d=d,
+                         itemsize=itemsize, bias=bias, dropout=dropout,
+                         segments=segments):
+                out.append({"block_q": bq, "block_k": bk})
+    return out
+
+
+def lm_head_ce_space(*, n: int, v: int, h: int,
+                     itemsize: int = 2) -> list[dict]:
+    """Legal ``{"block_t", "block_v"}`` candidates for the fused
+    LM-head CE kernels (forward and backward share the tiling knobs)."""
+    out = []
+    for bt in _clip_menu(_CE_BLOCK_T, n):
+        for bv in _clip_menu(_CE_BLOCK_V, v):
+            if vmem.fits("lm_head_ce", block_t=bt, block_v=bv, h=h,
+                         itemsize=itemsize):
+                out.append({"block_t": bt, "block_v": bv})
+    return out
+
+
+def config_space(kernel: str, shape: dict,
+                 flags: Optional[dict] = None) -> list[dict]:
+    """Dispatch on the cache's kernel naming: ``flash_attention_fwd``,
+    ``flash_attention_bwd``, ``lm_head_ce``. ``shape``/``flags`` use the
+    same field names the cache key is built from."""
+    flags = flags or {}
+    if kernel in ("flash_attention_fwd", "flash_attention_bwd"):
+        return flash_attention_space(
+            sq=shape["sq"], sk=shape["sk"], d=shape["d"],
+            itemsize=shape.get("itemsize", 2),
+            phase=kernel.rsplit("_", 1)[1],
+            bias=bool(flags.get("bias")), dropout=bool(flags.get("dropout")),
+            segments=bool(flags.get("segments")))
+    if kernel == "lm_head_ce":
+        return lm_head_ce_space(n=shape["n"], v=shape["v"], h=shape["h"],
+                                itemsize=shape.get("itemsize", 2))
+    raise ValueError(f"unknown kernel {kernel!r}; known: {vmem.KERNELS}")

@@ -1,0 +1,101 @@
+"""Shared VMEM-envelope model for the Pallas kernel autotuner.
+
+One place for the budget math that used to live in two: the
+``lm_head_ce._pick_blocks`` docstring (fp32 dE block + double-buffered
+operand blocks + the logits tile, against the raised 64 MB kernel
+budget) and the flash-attention module docstring's tile-cost accounting
+(the [block_q, block_k] fp32 score tile, plus one more tile each for an
+additive bias block and the regenerated dropout keep mask, against
+Mosaic's scoped-VMEM default). The config-space generator calls
+:func:`vmem_estimate` to prune illegal block grids *before* anything is
+compiled, so a sweep never burns its timeout on a config Mosaic would
+reject.
+
+These are calibrated ENVELOPES, not byte-exact Mosaic accounting (which
+depends on liveness analysis and buffer reuse the compiler owns). The
+budgets are set so that every hardware-verified shipping config passes
+and every hardware-verified failing config is pruned — the calibration
+points are quoted next to each constant. A config that passes the
+envelope can still, in principle, fail to compile on a future compiler;
+the sweep harness treats a compile failure as a skipped config, never an
+error.
+"""
+
+from __future__ import annotations
+
+# Mosaic's scoped-VMEM default is 16 MB/core. The flash kernels run
+# under it unraised; the envelope budget leaves headroom for the
+# compiler's own double-buffering and transients. Calibration (module
+# docstring of ops/flash_attention.py, all measured on v5e):
+#   pass: (1024, 1024) plain/causal/bias-only/dropout-only at d=64..128
+#   fail: (2048, 2048) any flavor; (1024, 1024) with bias AND dropout
+FLASH_VMEM_BUDGET = 12 * 1024 * 1024
+
+# ops/lm_head_ce.py requests a raised 64 MB scoped-VMEM limit (v5e has
+# 128 MB): the backward's resident set at the swept-optimal tiles is
+# ~24 MB standalone but grows to ~42 MB when the kernel sits inside a
+# remat/scan body that shares the scope. The envelope prunes configs
+# whose standalone resident set already exceeds the raised limit.
+LM_HEAD_VMEM_LIMIT = 64 * 1024 * 1024
+
+KERNELS = ("flash_attention_fwd", "flash_attention_bwd", "lm_head_ce")
+
+
+def budget_for(kernel: str) -> int:
+    if kernel in ("flash_attention_fwd", "flash_attention_bwd"):
+        return FLASH_VMEM_BUDGET
+    if kernel == "lm_head_ce":
+        return LM_HEAD_VMEM_LIMIT
+    raise ValueError(f"unknown kernel {kernel!r}; known: {KERNELS}")
+
+
+def _flash_common(block_q: int, block_k: int, d: int, itemsize: int) -> int:
+    # double-buffered operand blocks (q + k + v) in their native dtype,
+    # the output block, and the fp32 accumulator scratch
+    operands = 2 * (block_q + 2 * block_k) * d * itemsize
+    out = 2 * block_q * d * itemsize
+    acc = block_q * d * 4
+    return operands + out + acc
+
+
+def vmem_estimate(kernel: str, *, block_q: int = 0, block_k: int = 0,
+                  d: int = 0, block_t: int = 0, block_v: int = 0,
+                  h: int = 0, itemsize: int = 2, bias: bool = False,
+                  dropout: bool = False, segments: bool = False) -> int:
+    """Estimated resident VMEM bytes for one kernel program at the given
+    block config. Flash kernels take ``block_q/block_k/d``; ``lm_head_ce``
+    takes ``block_t/block_v/h``. ``itemsize`` is the operand dtype's.
+    """
+    if kernel == "flash_attention_fwd":
+        tile = block_q * block_k * 4
+        # one fp32 score/probability tile (Mosaic reuses the buffer
+        # across the s -> p passes), +1 tile for a resident bias block,
+        # +1 for the regenerated dropout keep mask; segment-id vectors
+        # are lane-thin and disappear into the headroom
+        n_tiles = 1 + (1 if bias else 0) + (1 if dropout else 0)
+        return n_tiles * tile + _flash_common(block_q, block_k, d, itemsize)
+    if kernel == "flash_attention_bwd":
+        tile = block_q * block_k * 4
+        # p and ds live simultaneously (dp folds into ds in-place);
+        # bias/dropout each add a resident tile exactly as forward
+        n_tiles = 2 + (1 if bias else 0) + (1 if dropout else 0)
+        # do block + the dq/dkdv fp32 accumulators
+        extra = 2 * block_q * d * itemsize + 2 * block_k * d * 4
+        return (n_tiles * tile + extra
+                + _flash_common(block_q, block_k, d, itemsize))
+    if kernel == "lm_head_ce":
+        # the _pick_blocks budget math, promoted: fp32 dE accumulator
+        # block + fp32 logits tile + double-buffered E/x operand blocks
+        # + the dx output tile (backward dominates the forward, which
+        # shares every term except dE/dx)
+        de = block_v * h * 4
+        logits = block_t * block_v * 4
+        operands = 2 * (block_v * h + block_t * h) * itemsize
+        dx = block_t * h * 4
+        return de + logits + operands + dx
+    raise ValueError(f"unknown kernel {kernel!r}; known: {KERNELS}")
+
+
+def fits(kernel: str, **kw) -> bool:
+    """Whether a config's envelope fits the kernel's budget."""
+    return vmem_estimate(kernel, **kw) <= budget_for(kernel)

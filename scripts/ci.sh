@@ -49,10 +49,10 @@ echo "== ci: bench streaming-evidence smoke =="
     BENCH_STREAM_PATH=/tmp/ci_bench_smoke_stream.jsonl \
     python "$REPO_DIR/bench.py" --smoke > /tmp/ci_bench_smoke.json ) || fail=1
 
-echo "== ci: overlap + zero-bubble + zero-sharded + fp8 bench sections in the evidence stream =="
+echo "== ci: overlap + zero-bubble + zero-sharded + fp8 + autotune bench sections in the evidence stream =="
 # the PR-4 overlap sections, the PR-5 pp_zero_bubble section, the
-# PR-6 zero_sharded_step section and the PR-7 fp8_step section must
-# land as flushed section lines
+# PR-6 zero_sharded_step section, the PR-7 fp8_step section and the
+# PR-8 autotune section must land as flushed section lines
 # (bench --smoke already asserts SMOKE_EXPECTED; this is the
 # independent driver-side check of the same contract)
 python - /tmp/ci_bench_smoke_stream.jsonl <<'EOF' || fail=1
@@ -63,12 +63,12 @@ for line in open(sys.argv[1]):
     if ev.get("kind") == "section":
         seen.add(ev.get("name"))
 missing = {"tp_overlap", "ddp_bucket_overlap", "pp_zero_bubble",
-           "zero_sharded_step", "fp8_step"} - seen
+           "zero_sharded_step", "fp8_step", "autotune"} - seen
 if missing:
     print(f"ci: sections missing from bench stream: {sorted(missing)}")
     raise SystemExit(1)
 print("ci: tp_overlap + ddp_bucket_overlap + pp_zero_bubble + "
-      "zero_sharded_step + fp8_step present in bench stream")
+      "zero_sharded_step + fp8_step + autotune present in bench stream")
 EOF
 
 if [[ "$fail" == "0" ]]; then

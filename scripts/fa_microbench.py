@@ -1,79 +1,24 @@
-"""Flash-attention kernel microbench: fwd+bwd at the bench GPT shape.
+"""Thin wrapper over the autotune CLI (PR 8) — the flash-attention
+block sweep that used to live here (scan-amortized fwd+bwd timing over
+hand-listed block configs) is now ONE sweep implementation in
+``apex_tpu.tune``:
 
-Times the attention custom-vjp alone (value_and_grad of sum(out)) over a
-scanned loop, so per-dispatch overhead amortizes.  Used for the round-5
-VPU-time experiments (asymmetric blocks, exp2, mask-free full blocks).
+    python -m apex_tpu.ops tune --kernel flash_attention \\
+        --shapes "b=8,h=16,s=1024,d=64,dtype=bf16,causal=1"
+
+This wrapper runs exactly that (the bench GPT shape), tuning the
+forward and backward independently and writing the persistent
+per-device cache that ``flash_attention(block_q=None, ...)`` resolves
+from. Extra arguments pass through, e.g. ``--cache DIR``,
+``--median-of 3``, another ``--shapes``.
 """
-import time
+import sys
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+from apex_tpu.ops.__main__ import main
 
-
-def time_fa(b=8, h=16, s=1024, d=64, causal=True, k=32, windows=5,
-            block_q=None, block_k=None, block_q_bwd=None, block_k_bwd=None,
-            dtype=jnp.bfloat16, layers=12):
-    from apex_tpu.ops.flash_attention import flash_attention
-
-    rng = np.random.RandomState(0)
-    q = jnp.asarray(rng.randn(b, h, s, d), dtype) * 0.1
-    kk = jnp.asarray(rng.randn(b, h, s, d), dtype) * 0.1
-    v = jnp.asarray(rng.randn(b, h, s, d), dtype) * 0.1
-
-    def one(q, kk, v):
-        def loss(q, kk, v):
-            o = flash_attention(q, kk, v, causal=causal,
-                                block_q=block_q, block_k=block_k,
-                                block_q_bwd=block_q_bwd,
-                                block_k_bwd=block_k_bwd)
-            return jnp.sum(o.astype(jnp.float32))
-        g = jax.grad(loss, argnums=(0, 1, 2))(q, kk, v)
-        return g
-
-    def body(carry, _):
-        q, kk, v = carry
-        dq, dk, dv = one(q, kk, v)
-        # feed grads back so nothing is DCE'd / hoisted
-        return (q + dq.astype(q.dtype) * 1e-6,
-                kk + dk.astype(kk.dtype) * 1e-6,
-                v + dv.astype(v.dtype) * 1e-6), ()
-
-    @jax.jit
-    def multi(carry):
-        carry, _ = jax.lax.scan(body, carry, None, length=k)
-        return carry, jnp.sum(carry[0].astype(jnp.float32))
-
-    carry = (q, kk, v)
-    out, chk = multi(carry)
-    float(chk)  # force remote completion (block_until_ready is not enough
-    # under the axon tunnel — a host transfer is)
-    times = []
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        _, chk = multi(carry)
-        float(chk)
-        times.append((time.perf_counter() - t0) / k)
-    times.sort()
-    med = times[len(times) // 2]
-    # per-layer per-step attention cost at the bench shape = this number
-    return med * 1e3  # ms per fwd+bwd call
-
+_DEFAULTS = ["tune", "--kernel", "flash_attention"]
+if not any(a.startswith("--shapes") for a in sys.argv[1:]):
+    _DEFAULTS += ["--shapes", "b=8,h=16,s=1024,d=64,dtype=bf16,causal=1"]
 
 if __name__ == "__main__":
-    import sys
-    cfgs = [
-        # NOTE: no-args row measures the CURRENT defaults (r5: fwd
-        # (1024,1024) + bwd (512,512) for causal s=1024); the explicit
-        # rows pin the given blocks for BOTH phases (back-compat rule)
-        ("defaults", dict()),
-        ("bq512 bk1024", dict(block_q=512, block_k=1024)),
-        ("bq256 bk1024", dict(block_q=256, block_k=1024)),
-        ("bq1024 bk1024", dict(block_q=1024, block_k=1024)),
-        ("bq256 bk512", dict(block_q=256, block_k=512)),
-    ]
-    if len(sys.argv) > 1 and sys.argv[1] == "quick":
-        cfgs = cfgs[:1]
-    for name, kw in cfgs:
-        ms = time_fa(**kw)
-        print(f"{name:24s} {ms:7.3f} ms/call  (x12 layers = {ms*12:6.2f} ms/step)")
+    sys.exit(main(_DEFAULTS + sys.argv[1:]))

@@ -1,75 +1,27 @@
-"""Standalone fused-vs-unfused LM-head+CE comparison at BERT and GPT
-shapes, with a fused tile sweep — the r5 root-cause probe for why the
-fused kernel won at GPT shape but measured ~2-4 ms slower at BERT shape
-in the r4 full-model check."""
-import time
+"""Thin wrapper over the autotune CLI (PR 8) — the fused LM-head CE
+tile sweep that used to live here (the r5 fused-vs-unfused root-cause
+probe with its hand-listed ``(bt, bv)`` grid) is now ONE sweep
+implementation in ``apex_tpu.tune``:
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+    python -m apex_tpu.ops tune --kernel lm_head_ce \\
+        --shapes "n=8192,v=32768,h=1024,dtype=bf16" \\
+        --shapes "n=16384,v=30522,h=768,dtype=bf16"
 
+This wrapper runs exactly that (the GPT and BERT bench shapes) and
+writes the persistent per-device cache that
+``fused_lm_head_cross_entropy(block_t=None, ...)`` resolves from. The
+fused-vs-unfused comparison lives in ``bench.py`` (sections ``gpt`` /
+``bert``); the historical sweep numbers are quoted in
+``ops/lm_head_ce.py:_pick_blocks``. Extra arguments pass through.
+"""
+import sys
 
-def timed(g, args, k, windows=5):
-    float(g(*args))
-    ts = []
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        float(g(*args))
-        ts.append(time.perf_counter() - t0)
-    return sorted(ts)[2] / k * 1e3
+from apex_tpu.ops.__main__ import main
 
-
-def bench_pair(n, V, h, k=32, bt=None, bv=None):
-    from apex_tpu.ops.lm_head_ce import fused_lm_head_cross_entropy
-    rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.randn(n, h) * 0.05, jnp.bfloat16)
-    E = jnp.asarray(rng.randn(V, h) * 0.05, jnp.bfloat16)
-    tgt = jnp.asarray(rng.randint(0, V, (n,)), jnp.int32)
-
-    def fused_loss(x, E):
-        return jnp.mean(fused_lm_head_cross_entropy(
-            x, E, tgt, block_t=bt, block_v=bv))
-
-    def unfused_loss(x, E):
-        logits = jax.lax.dot_general(
-            x, E, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
-        lse = jnp.log(jnp.sum(jnp.exp(logits - m), -1)) + m[:, 0]
-        pred = jnp.take_along_axis(logits, tgt[:, None], 1)[:, 0]
-        return jnp.mean(lse - pred)
-
-    out = {}
-    for name, lf in [("fused", fused_loss), ("unfused", unfused_loss)]:
-        def step(x, E):
-            l, (dx, dE) = jax.value_and_grad(lf, argnums=(0, 1))(x, E)
-            return (x + dx.astype(x.dtype) * 1e-6,
-                    E + dE.astype(E.dtype) * 1e-6)
-
-        @jax.jit
-        def g(x, E):
-            def body(c, _):
-                return step(*c), ()
-            (x2, E2), _ = jax.lax.scan(body, (x, E), None, length=k)
-            return jnp.sum(x2.astype(jnp.float32)) + jnp.sum(
-                E2[0].astype(jnp.float32))
-        out[name] = timed(g, (x, E), k)
-    return out
-
+_DEFAULTS = ["tune", "--kernel", "lm_head_ce"]
+if not any(a.startswith("--shapes") for a in sys.argv[1:]):
+    _DEFAULTS += ["--shapes", "n=8192,v=32768,h=1024,dtype=bf16",
+                  "--shapes", "n=16384,v=30522,h=768,dtype=bf16"]
 
 if __name__ == "__main__":
-    import sys
-    print("== GPT shape n=8192 V=32768 h=1024 ==")
-    r = bench_pair(8192, 32768, 1024)
-    print(f"  fused {r['fused']:.3f} ms  unfused {r['unfused']:.3f} ms")
-    print("== BERT shape n=16384 V=30522 h=768 ==")
-    r = bench_pair(16384, 30522, 768)
-    print(f"  fused {r['fused']:.3f} ms  unfused {r['unfused']:.3f} ms")
-    if len(sys.argv) > 1 and sys.argv[1] == "sweep":
-        for bt, bv in [(256, 2048), (512, 1024), (512, 4096), (1024, 2048),
-                       (512, 2048), (256, 4096)]:
-            try:
-                r = bench_pair(16384, 30522, 768, bt=bt, bv=bv)
-                print(f"  BERT fused bt={bt} bv={bv}: {r['fused']:.3f} ms")
-            except Exception as e:
-                print(f"  BERT fused bt={bt} bv={bv}: FAIL {str(e)[:70]}")
+    sys.exit(main(_DEFAULTS + sys.argv[1:]))

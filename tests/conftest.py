@@ -18,6 +18,17 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+import tempfile
+
+# Autotune isolation: the kernels' default policy is autotune="cache",
+# so a developer's user-level cache (~/.cache/apex_tpu/tune, written by
+# `python -m apex_tpu.ops tune`) would otherwise leak tuned blocks into
+# every test that asserts heuristic-default tilings/warnings. Point the
+# whole suite at a fresh empty dir; cache-exercising tests monkeypatch
+# their own over it.
+os.environ["APEX_TPU_TUNE_CACHE"] = tempfile.mkdtemp(
+    prefix="apex_tpu_test_tune_")
+
 import jax  # noqa: E402
 
 # The env var alone is not enough when a sitecustomize registers a PJRT

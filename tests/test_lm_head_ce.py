@@ -124,7 +124,13 @@ def tp_mesh():
     ps.destroy_model_parallel()
 
 
-@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+# smoothing=0.1 under TP is the measured-heavier half (r9 tier-1
+# budget); smoothing parity at both values stays default single-device
+# (test_matches_unfused_composition / test_grads_match_unfused) and the
+# vocab-parallel machinery stays default at 0.0 — the cross term rides
+# -m slow
+@pytest.mark.parametrize(
+    "smoothing", [0.0, pytest.param(0.1, marks=pytest.mark.slow)])
 def test_vocab_parallel_matches_dense(tp_mesh, smoothing):
     """tp=4 vocab shards + the three collectives == dense fused CE, in
     loss and in both grads (dE compared shard-against-slice)."""

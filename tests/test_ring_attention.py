@@ -80,6 +80,9 @@ def test_ulysses_attention():
     ps.destroy_model_parallel()
 
 
+@pytest.mark.slow   # measured-heaviest twin of test_ring_attention_grads
+                    # (r9 tier-1 budget); the non-causal FORWARD stays in
+                    # the default run via test_ring_attention_full
 def test_ring_attention_grads_noncausal():
     """Non-causal backward (second ring pass, traveling dk/dv accumulators)."""
     mesh = _setup(2)

@@ -602,7 +602,11 @@ def test_gpt_sequence_parallel_grads_match_plain_tp():
     ps.destroy_model_parallel()
 
 
-@pytest.mark.parametrize("sp", [False, True])
+# sp=True is the measured-heaviest variant (r9 tier-1 budget; the
+# sequence-parallel transport delta over sp=False is also covered by the
+# dedicated SP grad-parity sweeps) — run it with -m slow
+@pytest.mark.parametrize(
+    "sp", [False, pytest.param(True, marks=pytest.mark.slow)])
 def test_pipelined_gpt_interleaved_matches_sequential(sp):
     """The flagship composition (VERDICT r2 #1): real GPT blocks through
     the interleaved schedule at pp=2 x vpp=2 x tp=2 with remat and loss
