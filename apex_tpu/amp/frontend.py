@@ -35,6 +35,7 @@ from apex_tpu.amp._amp_state import _amp_state, maybe_print, warn_or_err
 from apex_tpu.amp.properties import Properties, opt_levels
 from apex_tpu.amp.scaler import LossScaler, ScalerState
 from apex_tpu.monitor import hooks as _mon
+from apex_tpu.monitor import profile as _prof
 from apex_tpu.utils.tree import cast_floating
 
 
@@ -509,12 +510,20 @@ def make_train_step(
 
     def step(_mon_on, params, opt_state, scaler_state: ScalerState,
              *batch):
-        grads, (loss, aux) = grad_fn(params, scaler_state, *batch)
-        grads, found_inf = _scaler_mod.unscale(grads, scaler_state, out_dtype=grad_dtype)
-        new_params, new_opt_state = optimizer.apply(
-            opt_state, params, grads, skip=found_inf
-        )
-        new_scaler_state = scaler.update_state(scaler_state, found_inf)
+        # profile scopes (monitor.profile): metadata-only tags — the
+        # jaxpr is byte-identical with or without them — that make the
+        # whole hot loop attributable per phase in the per-module table
+        with _prof.scope("amp_grad"):
+            grads, (loss, aux) = grad_fn(params, scaler_state, *batch)
+        with _prof.scope("amp_unscale"):
+            grads, found_inf = _scaler_mod.unscale(
+                grads, scaler_state, out_dtype=grad_dtype)
+        with _prof.scope("amp_optimizer"):
+            new_params, new_opt_state = optimizer.apply(
+                opt_state, params, grads, skip=found_inf
+            )
+        with _prof.scope("amp_scaler"):
+            new_scaler_state = scaler.update_state(scaler_state, found_inf)
         outs = (new_params, new_opt_state, new_scaler_state, loss)
         return outs + ((aux,) if has_aux else ())
 
@@ -552,13 +561,16 @@ def _make_fp8_train_step(loss_fn, optimizer, scaler, *, has_aux,
 
     def step(_mon_on, params, opt_state, scaler_state: ScalerState,
              fp8_state, *batch):
-        (grads, recorded), (loss, aux) = grad_fn(
-            params, fp8_state, scaler_state, *batch)
-        grads, found_inf = _scaler_mod.unscale(grads, scaler_state,
-                                               out_dtype=grad_dtype)
-        new_params, new_opt_state = optimizer.apply(
-            opt_state, params, grads, skip=found_inf
-        )
+        with _prof.scope("amp_grad"):
+            (grads, recorded), (loss, aux) = grad_fn(
+                params, fp8_state, scaler_state, *batch)
+        with _prof.scope("amp_unscale"):
+            grads, found_inf = _scaler_mod.unscale(grads, scaler_state,
+                                                   out_dtype=grad_dtype)
+        with _prof.scope("amp_optimizer"):
+            new_params, new_opt_state = optimizer.apply(
+                opt_state, params, grads, skip=found_inf
+            )
         updated = _fp8_mod.update_state(fp8_state, recorded,
                                         margin=fp8_margin)
         # overflow: the recorded amaxes came from an inf/nan backward —

@@ -436,6 +436,30 @@ def _flash_attention_tuned_step():
     return fn, (q, k, v), mesh.axis_names
 
 
+def _profiled_train_step():
+    """The amp train step traced with the profile-scope vocabulary live
+    (``monitor.profile.scope`` threads ``jax.named_scope`` tags through
+    amp/TP/pipeline/ops): keeps the scope plumbing itself inside the
+    zero-findings gate — a scope that imported jax at module level, did
+    jax work at import (APX001), or inserted side effects under jit
+    (APX005) would be caught here. The step is jitted with the explicit
+    APX007 opt-out: this entrypoint is only traced abstractly and its
+    toy inputs double as the checker's returned values."""
+    import jax
+    from apex_tpu import monitor
+    from apex_tpu.monitor import profile as profile_mod
+
+    step, args, allowed = _amp_train_step()
+    rec = monitor.Recorder(name="lint-profile-entrypoint")
+
+    def profiled(*a):
+        with monitor.attached(rec), profile_mod.scope("lint_step"):
+            return step._jitted(True, *a)
+
+    fn = jax.jit(profiled, donate_argnums=())
+    return fn, args, allowed
+
+
 def _fused_lm_head_ce():
     """Vocab-parallel fused LM-head CE: the pmax/psum trio over the
     tensor axis, plus the Pallas kernels in interpret mode."""
@@ -473,4 +497,5 @@ register_entrypoint("pp_zero_bubble_interleaved_step",
 register_entrypoint("zero3_train_step", _zero3_train_step)
 register_entrypoint("fp8_train_step", _fp8_train_step)
 register_entrypoint("flash_attention_tuned_step", _flash_attention_tuned_step)
+register_entrypoint("profiled_train_step", _profiled_train_step)
 register_entrypoint("fused_lm_head_ce", _fused_lm_head_ce)

@@ -33,6 +33,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.monitor import profile as _prof
 from apex_tpu.normalization import FusedLayerNorm
 from apex_tpu.utils.remat import resolve_remat_policy
 from apex_tpu.ops.flash_attention import flash_attention
@@ -145,51 +146,61 @@ class ParallelSelfAttention(nn.Module):
                 "(the ring paths are kernel-backed)")
         drop = (cfg.attention_dropout
                 if (cfg.attention_dropout > 0 and not deterministic) else 0.0)
-        if cfg.attention_impl == "flash":
-            qh = q.transpose(0, 2, 1, 3)          # [b, hp, s, d]
-            kh = k.transpose(0, 2, 1, 3)
-            vh = v.transpose(0, 2, 1, 3)
-            seed = None
-            if drop > 0.0:
-                # fold the tp rank into the seed: the kernel hashes the
-                # LOCAL head index, so replicated rngs would repeat masks
-                # across head shards (Megatron's per-rank RNG offsets,
-                # apex/transformer/tensor_parallel/random.py:131-206);
-                # the cp rank is folded per ring step inside the ring
-                seed = (jax.random.randint(self.make_rng("dropout"), (), 0,
-                                           2 ** 30 - 1, jnp.int32)
-                        + ps.get_tensor_model_parallel_rank())
-            if cp > 1:
-                # context parallel: zigzag ring attention over the local
-                # sequence shard (inputs/labels in zigzag layout, see
-                # GPT.__call__ position handling); causal by construction
-                ctx = zigzag_ring_self_attention(
-                    qh, kh, vh, scale=head_dim ** -0.5,
-                    dropout_rate=drop, dropout_seed=seed)
-            else:
-                ctx = flash_attention(qh, kh, vh, causal=True,
-                                      scale=head_dim ** -0.5,
-                                      dropout_rate=drop, dropout_seed=seed)
-            ctx = ctx.transpose(0, 2, 1, 3)       # [b, s, hp, d]
-        else:  # "fused_softmax": the unfused numerics-debug path
-            scores = jnp.einsum("bshd,bthd->bhst", q, k,
-                                preferred_element_type=jnp.float32)
-            softmax = FusedScaleMaskSoftmax(
-                input_in_bf16=cfg.dtype == jnp.bfloat16,
-                attn_mask_type=AttnMaskType.causal,
-                scale=head_dim ** -0.5,
-            )
-            probs = softmax(scores.astype(cfg.dtype))
-            if drop > 0.0:
-                # fold in the tp rank: identical keys across head shards
-                # would repeat dropout masks (see the flash path)
-                key = jax.random.fold_in(
-                    self.make_rng("dropout"),
-                    ps.get_tensor_model_parallel_rank())
-                probs = nn.Dropout(drop, deterministic=False)(probs, rng=key)
-            ctx = jnp.einsum("bhst,bthd->bshd", probs.astype(cfg.dtype), v,
-                             preferred_element_type=jnp.float32).astype(cfg.dtype)
-        ctx = ctx.reshape(b, s, heads_per * head_dim)
+        # profile scope (monitor.profile): the attention core — score/
+        # context matmuls or the flash kernel — attributed as one module
+        # (metadata-only: the jaxpr is byte-identical without the tag)
+        with _prof.scope("attn_core"):
+            if cfg.attention_impl == "flash":
+                qh = q.transpose(0, 2, 1, 3)          # [b, hp, s, d]
+                kh = k.transpose(0, 2, 1, 3)
+                vh = v.transpose(0, 2, 1, 3)
+                seed = None
+                if drop > 0.0:
+                    # fold the tp rank into the seed: the kernel hashes
+                    # the LOCAL head index, so replicated rngs would
+                    # repeat masks across head shards (Megatron's
+                    # per-rank RNG offsets, apex/transformer/
+                    # tensor_parallel/random.py:131-206); the cp rank is
+                    # folded per ring step inside the ring
+                    seed = (jax.random.randint(self.make_rng("dropout"),
+                                               (), 0, 2 ** 30 - 1,
+                                               jnp.int32)
+                            + ps.get_tensor_model_parallel_rank())
+                if cp > 1:
+                    # context parallel: zigzag ring attention over the
+                    # local sequence shard (inputs/labels in zigzag
+                    # layout, see GPT.__call__ position handling);
+                    # causal by construction
+                    ctx = zigzag_ring_self_attention(
+                        qh, kh, vh, scale=head_dim ** -0.5,
+                        dropout_rate=drop, dropout_seed=seed)
+                else:
+                    ctx = flash_attention(qh, kh, vh, causal=True,
+                                          scale=head_dim ** -0.5,
+                                          dropout_rate=drop,
+                                          dropout_seed=seed)
+                ctx = ctx.transpose(0, 2, 1, 3)       # [b, s, hp, d]
+            else:  # "fused_softmax": the unfused numerics-debug path
+                scores = jnp.einsum("bshd,bthd->bhst", q, k,
+                                    preferred_element_type=jnp.float32)
+                softmax = FusedScaleMaskSoftmax(
+                    input_in_bf16=cfg.dtype == jnp.bfloat16,
+                    attn_mask_type=AttnMaskType.causal,
+                    scale=head_dim ** -0.5,
+                )
+                probs = softmax(scores.astype(cfg.dtype))
+                if drop > 0.0:
+                    # fold in the tp rank: identical keys across head
+                    # shards would repeat dropout masks (see flash path)
+                    key = jax.random.fold_in(
+                        self.make_rng("dropout"),
+                        ps.get_tensor_model_parallel_rank())
+                    probs = nn.Dropout(drop, deterministic=False)(
+                        probs, rng=key)
+                ctx = jnp.einsum("bhst,bthd->bshd", probs.astype(cfg.dtype),
+                                 v, preferred_element_type=jnp.float32
+                                 ).astype(cfg.dtype)
+            ctx = ctx.reshape(b, s, heads_per * head_dim)
         return RowParallelLinear(
             input_size=h, output_size=h, input_is_parallel=True,
             sequence_parallel=sp, sequence_dim=1,
@@ -396,11 +407,17 @@ class GPT(nn.Module):
         return logits  # [b, s, V/tp] (full V at tp=1)
 
     def _ce(self, variables, hidden_or_logits, labels):
+        # profile scope at the CALL site, not inside the CE functions:
+        # vocab_parallel_cross_entropy is a custom_vjp primal, and a
+        # scope inside a primal body never reaches the differentiated
+        # trace (custom_vjp traces the fwd/bwd rules instead)
         if self.cfg.fused_lm_head:
             emb = variables["params"]["wte"]["embedding"]
-            return fused_lm_head_cross_entropy(
-                hidden_or_logits, emb, labels, axis_name=ps.TENSOR_AXIS)
-        return vocab_parallel_cross_entropy(hidden_or_logits, labels)
+            with _prof.scope("lm_head_ce"):
+                return fused_lm_head_cross_entropy(
+                    hidden_or_logits, emb, labels, axis_name=ps.TENSOR_AXIS)
+        with _prof.scope("vocab_ce"):
+            return vocab_parallel_cross_entropy(hidden_or_logits, labels)
 
     def loss(self, variables, ids, labels):
         fused = self.cfg.fused_lm_head

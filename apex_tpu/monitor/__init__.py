@@ -11,7 +11,12 @@ cross-host merge layer (``monitor.merge``: rank-tagged shards +
 ``python -m apex_tpu.monitor merge`` + in-mesh ``allgather_summaries``),
 a training-health :class:`Watchdog` (``monitor.health``: NaN/overflow-
 storm/divergence/plateau/starvation/straggler detection as typed
-``health_event`` records), and a CLI report
+``health_event`` records), per-module cost attribution
+(``monitor.profile``: :func:`scope` tags + the analytic jaxpr
+attributor + measured wall-time sampling,
+``python -m apex_tpu.monitor profile``), bench-trajectory regression
+detection (``monitor.regress``: versioned round loader + noise-aware
+verdicts, ``python -m apex_tpu.monitor regress``), and a CLI report
 (``python -m apex_tpu.monitor report run.jsonl``).
 
 Quick start::
@@ -49,9 +54,12 @@ from apex_tpu.monitor import _state
 from apex_tpu.monitor import health  # noqa: F401
 from apex_tpu.monitor import hooks  # noqa: F401
 from apex_tpu.monitor import merge  # noqa: F401
+from apex_tpu.monitor import profile  # noqa: F401
+from apex_tpu.monitor import regress  # noqa: F401
 from apex_tpu.monitor import trace  # noqa: F401
 from apex_tpu.monitor import xprof  # noqa: F401
 from apex_tpu.monitor.health import Watchdog  # noqa: F401
+from apex_tpu.monitor.profile import scope  # noqa: F401
 from apex_tpu.monitor.recorder import Recorder  # noqa: F401
 from apex_tpu.monitor.report import (  # noqa: F401
     aggregate, load_jsonl, render_cross_host, render_report, render_steps,

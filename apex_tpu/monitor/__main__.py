@@ -2,6 +2,8 @@
 
     python -m apex_tpu.monitor report run.jsonl [--json] [--max-rows N]
     python -m apex_tpu.monitor merge SHARD... [--json] [-o OUT.json]
+    python -m apex_tpu.monitor profile [--model gpt|mlp] [--measured]
+    python -m apex_tpu.monitor regress RUNS... [--against BASELINE.json]
     python -m apex_tpu.monitor selfcheck [--steps N]
 
 ``report`` renders the per-step and aggregate tables from a
@@ -10,9 +12,17 @@ training telemetry instead of per-kernel nvprof records). ``merge``
 combines rank-tagged shards (``monitor-<rank>.jsonl``, or a directory
 holding them) from a multi-process run into one cross-host view:
 collective bytes summed across ranks, per-rank timer distributions
-with straggler percentiles, per-rank step-time skew. ``selfcheck``
-records a synthetic 3-step amp run on CPU and asserts the dump → report
-round trip (used by ``scripts/ci.sh``).
+with straggler percentiles, per-rank step-time skew. ``profile``
+builds a model train step (GPT by default; shape knobs below) and
+prints the per-module cost attribution table — analytic FLOPs/bytes
+per profile scope, optionally merged with measured eager wall times
+(``--measured``) and an XProf per-op table (``--per-op``, subsuming
+the old ``scripts/profile_gpt.py``). ``regress`` loads bench evidence
+rounds (driver ``BENCH_r*.json`` wrappers, assembled bench JSON, or
+``bench_stream.jsonl`` streams), degrades per round, and renders
+noise-aware verdicts — exit status is non-zero only on a confirmed
+regression. ``selfcheck`` records a synthetic 3-step amp run on CPU
+and asserts the dump → report round trip (used by ``scripts/ci.sh``).
 """
 
 from __future__ import annotations
@@ -43,6 +53,56 @@ def main(argv=None) -> int:
                     help="print the merged view as JSON")
     pm.add_argument("-o", "--out", default=None,
                     help="also write the merged JSON here")
+
+    pp = sub.add_parser("profile",
+                        help="per-module cost attribution for a model "
+                             "train step")
+    pp.add_argument("--model", choices=("gpt", "mlp"), default="gpt")
+    pp.add_argument("--batch", type=int, default=2)
+    pp.add_argument("--seq", type=int, default=64)
+    pp.add_argument("--hidden", type=int, default=64)
+    pp.add_argument("--layers", type=int, default=2)
+    pp.add_argument("--heads", type=int, default=2)
+    pp.add_argument("--vocab", type=int, default=256)
+    pp.add_argument("--dtype", choices=("float32", "bfloat16"),
+                    default="float32")
+    pp.add_argument("--attention", choices=("fused_softmax", "flash"),
+                    default="fused_softmax",
+                    help="fused_softmax keeps every matmul visible to "
+                         "the analytic FLOP model; flash traces the "
+                         "Pallas kernel (0 analytic FLOPs)")
+    pp.add_argument("--fused-lm-head", action="store_true",
+                    help="fuse the LM-head CE kernel (Pallas; 0 "
+                         "analytic FLOPs for the head)")
+    pp.add_argument("--measured", action="store_true",
+                    help="also sample per-scope wall time eagerly "
+                         "(jax.disable_jit)")
+    pp.add_argument("--repeats", type=int, default=3,
+                    help="eager repeats for --measured")
+    pp.add_argument("--per-op", action="store_true",
+                    help="also run an XProf trace and print the per-op "
+                         "table (needs a device; the old "
+                         "scripts/profile_gpt.py output)")
+    pp.add_argument("--json", action="store_true")
+    pp.add_argument("--max-rows", type=int, default=40)
+
+    pg = sub.add_parser("regress",
+                        help="bench-trajectory verdicts over evidence "
+                             "rounds")
+    pg.add_argument("runs", nargs="+",
+                    help="evidence rounds in chronological order: "
+                         "BENCH_r*.json driver wrappers, assembled "
+                         "bench JSON, or bench_stream.jsonl streams")
+    pg.add_argument("--against", default=None, metavar="BASELINE.json",
+                    help="extra baseline round prepended to the history")
+    pg.add_argument("--json", action="store_true")
+    pg.add_argument("--nmad", type=float, default=3.0,
+                    help="MAD multiplier for the noise band")
+    pg.add_argument("--rel-tol", type=float, default=0.05,
+                    help="relative floor of the noise band")
+    pg.add_argument("--min-history", type=int, default=3,
+                    help="comparable prior rounds required before a "
+                         "regression verdict can gate")
 
     ps = sub.add_parser("selfcheck",
                         help="record a synthetic run; assert round-trip")
@@ -80,11 +140,91 @@ def main(argv=None) -> int:
             print(report_mod.render_cross_host(merged))
         return 0
 
+    if args.cmd == "regress":
+        from apex_tpu.monitor import regress as regress_mod
+        rounds = regress_mod.load_rounds(args.runs)
+        against = (regress_mod.load_round(args.against)
+                   if args.against else None)
+        rep = regress_mod.compare(rounds, against=against, nmad=args.nmad,
+                                  rel_tol=args.rel_tol,
+                                  min_history=args.min_history)
+        if args.json:
+            print(json.dumps(json_safe(rep), indent=2))
+        else:
+            print(regress_mod.render_regress(rep))
+        return rep["exit_code"]
+
+    if args.cmd == "profile":
+        return _run_profile(args)
+
     # selfcheck needs a backend; default to CPU unless the caller chose
     import os
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     report_mod.selfcheck(n_steps=args.steps, verbose=not args.quiet)
     return 0
+
+
+def _run_profile(args) -> int:
+    from apex_tpu.monitor import profile as profile_mod
+    from apex_tpu.monitor.recorder import json_safe
+
+    # the ONE step recipe shared with the bench `profile` section
+    step, step_args = profile_mod.demo_train_step(
+        args.model, batch=args.batch, seq=args.seq, hidden=args.hidden,
+        layers=args.layers, heads=args.heads, vocab=args.vocab,
+        dtype=args.dtype, attention=args.attention,
+        fused_lm_head=args.fused_lm_head)
+    prof = profile_mod.analytic_profile(step, *step_args)
+    measured = None
+    if args.measured:
+        measured = profile_mod.measured_profile(step, *step_args,
+                                                repeats=args.repeats)
+    if args.json:
+        print(json.dumps(json_safe(
+            {"analytic": prof, "measured": measured}), indent=2))
+    else:
+        print(profile_mod.render_profile(prof, measured=measured,
+                                         max_rows=args.max_rows))
+    if args.per_op:
+        # with --json, stdout must stay ONE parseable document: the
+        # human-readable per-op table moves to stderr
+        _profile_per_op(step, step_args,
+                        out=sys.stderr if args.json else sys.stdout)
+    return 0
+
+
+def _profile_per_op(step, step_args, out=None):
+    """XProf per-op table (the old ``scripts/profile_gpt.py`` body):
+    trace one warm step, parse the op stats. Degrades with a notice
+    when the platform yields no parseable trace."""
+    import tempfile
+
+    from apex_tpu import monitor
+
+    out = out if out is not None else sys.stdout
+    try:
+        _block(step(*step_args))        # compile + warm
+        d = tempfile.mkdtemp(prefix="apx_profile_")
+        with monitor.trace.trace(d):
+            _block(step(*step_args))
+        rows = monitor.xprof.op_stats(d)
+        tot = sum(r["total_self_time_us"] or 0 for r in rows)
+        print(f"\ntotal device self time: {tot / 1e3:.2f} ms", file=out)
+        print(f"{'self_us':>10} {'pct':>6} {'bound':>8}  operation",
+              file=out)
+        for r in rows[:45]:
+            print(f"{r['total_self_time_us'] or 0:10.0f} "
+                  f"{r['device_self_time_pct'] or 0:6.2f} "
+                  f"{str(r['bound_by'] or ''):>8}  "
+                  f"{r['operation'][:110]}", file=out)
+    except Exception as e:                              # noqa: BLE001
+        print(f"\n(per-op XProf table unavailable here: "
+              f"{type(e).__name__}: {e})", file=sys.stderr)
+
+
+def _block(out):
+    import jax
+    jax.block_until_ready(out)
 
 
 if __name__ == "__main__":

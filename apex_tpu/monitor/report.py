@@ -57,6 +57,7 @@ def aggregate(events: Iterable[dict], header: Optional[dict] = None) -> dict:
     collectives: dict[str, dict] = {}
     schedules: dict[str, dict] = {}
     utilization: dict[str, dict] = {}
+    profile_rows: dict[str, dict] = {}
     steps: list[dict] = []
     health: list[dict] = []
     for ev in events:
@@ -97,6 +98,15 @@ def aggregate(events: Iterable[dict], header: Optional[dict] = None) -> dict:
                 if valid:
                     s["valid"] += 1
                     row["slots_valid"] += 1
+        elif kind == "profile":
+            # per-scope analytic attribution rows (monitor.profile,
+            # analytic_profile(record=True)); last emission wins
+            row = {"flops": ev.get("value")}
+            for k in ("hbm_bytes", "collective_bytes", "eqns",
+                      "pallas_calls", "flops_scope_coverage"):
+                if ev.get(k) is not None:
+                    row[k] = ev[k]
+            profile_rows[name] = row
         elif kind == "step":
             steps.append(ev)
         elif kind == "health_event":
@@ -143,6 +153,16 @@ def aggregate(events: Iterable[dict], header: Optional[dict] = None) -> dict:
                 "slots_total": tot, "slots_valid": val,
                 "idle_fraction": round(1.0 - val / tot, 6) if tot else 0.0}
         out["pipeline_utilization"] = utilization
+    measured = {k[len("profile/"):]: dict(v) for k, v in timers.items()
+                if k.startswith("profile/")}
+    if profile_rows or measured:
+        prof: dict = {}
+        if profile_rows:
+            prof["analytic"] = {k: profile_rows[k]
+                                for k in sorted(profile_rows)}
+        if measured:
+            prof["measured"] = measured
+        out["profile"] = prof
     if health:
         out["health"] = health
     return out
@@ -249,6 +269,24 @@ def render_report(events: list[dict], header: Optional[dict] = None,
                     f"| {sched} | {rank} | {row.get('ticks', '')} "
                     f"| {row['slots_total']} | {row['slots_valid']} "
                     f"| {per} | {row['idle_fraction']} |")
+    if agg.get("profile"):
+        prof = agg["profile"]
+        parts.append("\n## profile (per-module cost attribution)\n")
+        analytic = prof.get("analytic") or {}
+        measured = prof.get("measured") or {}
+        names = sorted(set(analytic) | set(measured),
+                       key=lambda n: -(analytic.get(n, {}).get("flops")
+                                       or 0))
+        parts.append("| scope | flops | hbm bytes | coll bytes | "
+                     "wall ms (measured) |\n|---|---|---|---|---|")
+        for n in names[:max_rows]:
+            a = analytic.get(n, {})
+            m = measured.get(n)
+            wall = f"{1e3 * m['mean_s']:.3f}" if m else ""
+            parts.append(
+                f"| {n} | {_fmt(a.get('flops', ''))} "
+                f"| {_fmt(a.get('hbm_bytes', ''))} "
+                f"| {_fmt(a.get('collective_bytes', ''))} | {wall} |")
     if agg.get("timers"):
         parts.append("\n## timers\n")
         parts.append("| timer | n | total s | mean s |\n|---|---|---|---|")

@@ -56,12 +56,17 @@ def init(enable: bool = True):
 
 @contextlib.contextmanager
 def annotate(name: str, **metadata):
-    """Named range visible in the XProf host timeline and HLO op names."""
+    """Named range visible in the XProf host timeline and HLO op names.
+
+    The named scope rides :func:`apex_tpu.monitor.profile.scope`, so an
+    ``annotate``-tagged region also appears as a row in the per-module
+    cost attribution table (``monitor.profile.analytic_profile``)."""
     import jax
+    from apex_tpu.monitor import profile as _profile
     payload = name if not metadata else \
         f"{name}|{json.dumps(metadata, default=str)}"
     with jax.profiler.TraceAnnotation(payload):
-        with jax.named_scope(name):
+        with _profile.scope(name):
             yield
 
 

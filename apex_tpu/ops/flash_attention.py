@@ -1077,7 +1077,11 @@ def flash_attention(q, k, v, segment_ids_q=None, segment_ids_kv=None,
             raise ValueError(
                 f"bias must broadcast to [{b}, {h}, {sq}, {sk}], got "
                 f"{bias.shape}")
-    return _flash_attention(q, k, v, segment_ids_q, segment_ids_kv, bias,
-                            seed, causal, scale, float(dropout_rate),
-                            block_q, block_k, block_q_bwd, block_k_bwd,
-                            interpret)
+    # profile scope (monitor.profile): the kernel call (fwd + its
+    # custom-vjp backward) attributed as one module; metadata-only
+    from apex_tpu.monitor import profile as _prof
+    with _prof.scope("flash_attention"):
+        return _flash_attention(q, k, v, segment_ids_q, segment_ids_kv,
+                                bias, seed, causal, scale,
+                                float(dropout_rate), block_q, block_k,
+                                block_q_bwd, block_k_bwd, interpret)

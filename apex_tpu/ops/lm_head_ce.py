@@ -414,7 +414,11 @@ def fused_lm_head_cross_entropy(
         # defined zeros in the padded rows (in-kernel masking by v_local
         # keeps them out of every reduction; OOB reads would be garbage)
         embedding = jnp.pad(embedding, ((0, v_pad - v_local), (0, 0)))
-    loss = _fused_ce(xf, embedding, tgt[None], label_smoothing, axis_name,
-                     block_t, block_v, v_local,
-                     _resolve_interpret(interpret))
+    # profile scope (monitor.profile): the fused LM-head CE kernel (fwd
+    # + custom-vjp backward) attributed as one module; metadata-only
+    from apex_tpu.monitor import profile as _prof
+    with _prof.scope("lm_head_ce"):
+        loss = _fused_ce(xf, embedding, tgt[None], label_smoothing,
+                         axis_name, block_t, block_v, v_local,
+                         _resolve_interpret(interpret))
     return loss[:n].reshape(lead)

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from apex_tpu._compat import axis_size as _axis_size
 from apex_tpu.monitor import hooks as _mon
+from apex_tpu.monitor import profile as _prof
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.microbatches import resolve_num_microbatches
 from apex_tpu.transformer.pipeline_parallel.backward_split import (
@@ -35,6 +36,17 @@ from apex_tpu.transformer.pipeline_parallel.backward_split import (
 from apex_tpu.transformer.pipeline_parallel.p2p import (
     ring_shift, send_backward_recv_backward, send_forward_recv_forward)
 from apex_tpu.utils.remat import resolve_remat_policy
+
+
+def _scoped_tick(name: str, body: Callable) -> Callable:
+    """Wrap a scan tick/flush body in a profile scope
+    (``monitor.profile``): every equation the body traces is charged to
+    ``name`` in the per-module attribution table. Metadata-only — the
+    scan jaxpr is byte-identical with or without the tag."""
+    def wrapped(carry, t):
+        with _prof.scope(name):
+            return body(carry, t)
+    return wrapped
 
 
 def _checkpointed(stage_fn: Callable, remat: bool, remat_policy):
@@ -105,7 +117,8 @@ def pipeline_apply(stage_fn: Callable, stage_params, x,
         held_next = send_forward_recv_forward(out, axis_name)
         return (held_next, outputs), None
 
-    (_, outputs), _ = jax.lax.scan(tick, (init_held, init_out),
+    (_, outputs), _ = jax.lax.scan(_scoped_tick("pp_tick", tick),
+                                   (init_held, init_out),
                                    jnp.arange(total_ticks))
     return outputs
 
@@ -461,7 +474,7 @@ def forward_backward_pipelining_1f1b_model(
         return (held_f, held_b, stash, grads, loss_sum), None
 
     (_, _, _, grads, loss_sum), _ = jax.lax.scan(
-        tick, init, jnp.arange(total_ticks))
+        _scoped_tick("pp_tick", tick), init, jnp.arange(total_ticks))
     return loss_sum, grads
 
 
@@ -650,7 +663,7 @@ def forward_backward_pipelining_1f1b_interleaved_model(
         return (held_f, held_b, stash, grads, loss_sum), None
 
     (_, _, _, grads, loss_sum), _ = jax.lax.scan(
-        tick, init, jnp.arange(total_ticks))
+        _scoped_tick("pp_tick", tick), init, jnp.arange(total_ticks))
     return loss_sum, grads
 
 
@@ -896,7 +909,7 @@ def forward_backward_pipelining_zb_model(
         return (held_f, held_b, stash, wstash, grads, loss_sum), None
 
     (_, _, _, wstash, grads, loss_sum), _ = jax.lax.scan(
-        tick, init, jnp.arange(total_ticks))
+        _scoped_tick("pp_tick", tick), init, jnp.arange(total_ticks))
 
     if K:
         # -- deferred-wgrad flush: the bubble ticks' wgrad work, run
@@ -916,7 +929,8 @@ def forward_backward_pipelining_zb_model(
             return jax.tree.map(jnp.add, stage_grads, d), None
 
         stage_grads, _ = jax.lax.scan(
-            flush, grads["stage"], jnp.arange(K))
+            _scoped_tick("pp_wgrad_flush", flush), grads["stage"],
+            jnp.arange(K))
         grads = dict(grads, stage=stage_grads)
     return loss_sum, grads
 
@@ -1142,7 +1156,7 @@ def forward_backward_pipelining_zb_interleaved_model(
         return (held_f, held_b, stash, wstash, grads, loss_sum), None
 
     (_, _, _, wstash, grads, loss_sum), _ = jax.lax.scan(
-        tick, init, jnp.arange(total_ticks))
+        _scoped_tick("pp_tick", tick), init, jnp.arange(total_ticks))
 
     if not eager:
         # dense flush over every (chunk, microbatch) unit — all valid
@@ -1163,7 +1177,8 @@ def forward_backward_pipelining_zb_interleaved_model(
                 stage_grads, d), None
 
         stage_grads, _ = jax.lax.scan(
-            flush, grads["stage"], jnp.arange(n_units))
+            _scoped_tick("pp_wgrad_flush", flush), grads["stage"],
+            jnp.arange(n_units))
         grads = dict(grads, stage=stage_grads)
     return loss_sum, grads
 

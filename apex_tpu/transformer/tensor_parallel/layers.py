@@ -33,6 +33,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.monitor import profile as _prof
 from apex_tpu.parallel import overlap
 from apex_tpu.transformer import parallel_state as ps
 from apex_tpu.transformer.tensor_parallel import mappings
@@ -158,36 +159,39 @@ class ColumnParallelLinear(nn.Module):
             "kernel",
             _sliced_init(self.init_method, (self.input_size, self.output_size), 1, self.axis_name),
             (self.input_size, out_per), self.param_dtype)
-        y = None
-        if self.sequence_parallel and world > 1:
-            if self.overlap_comm:
-                # explicit comms/compute overlap (parallel/overlap.py):
-                # the sequence all-gather is ring-decomposed so each
-                # ppermute hop hides behind the previous shard's partial
-                # matmul; the custom_vjp backward uses the conjugate
-                # matmul→reduce-scatter ring. Off (default) this layer's
-                # jaxpr is byte-identical to the blocking form.
-                y = overlap.all_gather_matmul(
-                    x, kernel.astype(x.dtype), self.axis_name,
-                    self.sequence_dim)
-            else:
-                x = mappings.gather_from_sequence_parallel_region(
-                    x, self.axis_name, self.sequence_dim)
-        elif world > 1:
-            x = mappings.copy_to_tensor_model_parallel_region(x, self.axis_name)
-        if y is None:
-            y = jnp.dot(x, kernel.astype(x.dtype),
-                        preferred_element_type=jnp.float32).astype(x.dtype)
-        bias = None
-        if self.use_bias:
-            bias = self.param(
-                "bias",
-                _sliced_init(nn.initializers.zeros, (self.output_size,), 0, self.axis_name),
-                (out_per,), self.param_dtype)
-            if not self.skip_bias_add:
-                y = y + bias.astype(y.dtype)
-        if self.gather_output and world > 1:
-            y = mappings.gather_from_tensor_model_parallel_region(y, self.axis_name)
+        # profile scope (monitor.profile): the per-module attribution
+        # tag — metadata only, the jaxpr is byte-identical without it
+        with _prof.scope(self.name or "column_linear"):
+            y = None
+            if self.sequence_parallel and world > 1:
+                if self.overlap_comm:
+                    # explicit comms/compute overlap (parallel/overlap.py):
+                    # the sequence all-gather is ring-decomposed so each
+                    # ppermute hop hides behind the previous shard's partial
+                    # matmul; the custom_vjp backward uses the conjugate
+                    # matmul→reduce-scatter ring. Off (default) this layer's
+                    # jaxpr is byte-identical to the blocking form.
+                    y = overlap.all_gather_matmul(
+                        x, kernel.astype(x.dtype), self.axis_name,
+                        self.sequence_dim)
+                else:
+                    x = mappings.gather_from_sequence_parallel_region(
+                        x, self.axis_name, self.sequence_dim)
+            elif world > 1:
+                x = mappings.copy_to_tensor_model_parallel_region(x, self.axis_name)
+            if y is None:
+                y = jnp.dot(x, kernel.astype(x.dtype),
+                            preferred_element_type=jnp.float32).astype(x.dtype)
+            bias = None
+            if self.use_bias:
+                bias = self.param(
+                    "bias",
+                    _sliced_init(nn.initializers.zeros, (self.output_size,), 0, self.axis_name),
+                    (out_per,), self.param_dtype)
+                if not self.skip_bias_add:
+                    y = y + bias.astype(y.dtype)
+            if self.gather_output and world > 1:
+                y = mappings.gather_from_tensor_model_parallel_region(y, self.axis_name)
         if self.skip_bias_add:
             return y, bias
         return y
@@ -226,32 +230,34 @@ class RowParallelLinear(nn.Module):
             "kernel",
             _sliced_init(self.init_method, (self.input_size, self.output_size), 0, self.axis_name),
             (in_per, self.output_size), self.param_dtype)
-        if not self.input_is_parallel and world > 1:
-            x = mappings.scatter_to_tensor_model_parallel_region(x, self.axis_name)
-        if self.sequence_parallel and world > 1 and self.overlap_comm:
-            # transpose pattern of the column layer's overlap: the
-            # sequence reduce-scatter is ring-decomposed, each partial
-            # matmul hiding the travelling accumulator's ppermute hop.
-            # Reassociates the cross-rank sum — dtype-tolerance parity
-            # with the fused psum_scatter, not bitwise.
-            y = overlap.matmul_reduce_scatter(
-                x, kernel.astype(x.dtype), self.axis_name,
-                self.sequence_dim)
-        else:
-            y = jnp.dot(x, kernel.astype(x.dtype),
-                        preferred_element_type=jnp.float32).astype(x.dtype)
-            if world > 1:
-                if self.sequence_parallel:
-                    y = mappings.reduce_scatter_to_sequence_parallel_region(
-                        y, self.axis_name, self.sequence_dim)
-                else:
-                    y = mappings.reduce_from_tensor_model_parallel_region(y, self.axis_name)
-        bias = None
-        if self.use_bias:
-            bias = self.param("bias", nn.initializers.zeros,
-                              (self.output_size,), self.param_dtype)
-            if not self.skip_bias_add:
-                y = y + bias.astype(y.dtype)
+        # profile scope (monitor.profile): metadata-only attribution tag
+        with _prof.scope(self.name or "row_linear"):
+            if not self.input_is_parallel and world > 1:
+                x = mappings.scatter_to_tensor_model_parallel_region(x, self.axis_name)
+            if self.sequence_parallel and world > 1 and self.overlap_comm:
+                # transpose pattern of the column layer's overlap: the
+                # sequence reduce-scatter is ring-decomposed, each partial
+                # matmul hiding the travelling accumulator's ppermute hop.
+                # Reassociates the cross-rank sum — dtype-tolerance parity
+                # with the fused psum_scatter, not bitwise.
+                y = overlap.matmul_reduce_scatter(
+                    x, kernel.astype(x.dtype), self.axis_name,
+                    self.sequence_dim)
+            else:
+                y = jnp.dot(x, kernel.astype(x.dtype),
+                            preferred_element_type=jnp.float32).astype(x.dtype)
+                if world > 1:
+                    if self.sequence_parallel:
+                        y = mappings.reduce_scatter_to_sequence_parallel_region(
+                            y, self.axis_name, self.sequence_dim)
+                    else:
+                        y = mappings.reduce_from_tensor_model_parallel_region(y, self.axis_name)
+            bias = None
+            if self.use_bias:
+                bias = self.param("bias", nn.initializers.zeros,
+                                  (self.output_size,), self.param_dtype)
+                if not self.skip_bias_add:
+                    y = y + bias.astype(y.dtype)
         if self.skip_bias_add:
             return y, bias
         return y
@@ -283,18 +289,20 @@ class VocabParallelEmbedding(nn.Module):
             (per, self.embedding_dim), self.param_dtype)
 
     def __call__(self, ids):
-        world = ps._axis_size(self.axis_name)
-        table = self.embedding
-        if world == 1:
-            return jnp.take(table, ids, axis=0)
-        rank = ps.get_tensor_model_parallel_rank()
-        start = rank * self._per
-        local = ids - start
-        in_range = (local >= 0) & (local < self._per)
-        local = jnp.where(in_range, local, 0)
-        emb = jnp.take(table, local, axis=0)
-        emb = jnp.where(in_range[..., None], emb, 0.0)
-        return mappings.reduce_from_tensor_model_parallel_region(emb, self.axis_name)
+        with _prof.scope(self.name or "vocab_embedding"):
+            world = ps._axis_size(self.axis_name)
+            table = self.embedding
+            if world == 1:
+                return jnp.take(table, ids, axis=0)
+            rank = ps.get_tensor_model_parallel_rank()
+            start = rank * self._per
+            local = ids - start
+            in_range = (local >= 0) & (local < self._per)
+            local = jnp.where(in_range, local, 0)
+            emb = jnp.take(table, local, axis=0)
+            emb = jnp.where(in_range[..., None], emb, 0.0)
+            return mappings.reduce_from_tensor_model_parallel_region(
+                emb, self.axis_name)
 
     def attend(self, x):
         """Logits against the table shard: [..., h] -> [..., V/tp].
@@ -305,7 +313,9 @@ class VocabParallelEmbedding(nn.Module):
         embedding-backward matmuls onto fp32 operands.
         ``vocab_parallel_cross_entropy`` does its reductions in fp32.
         """
-        return jnp.einsum("...h,vh->...v", x, self.embedding.astype(x.dtype))
+        with _prof.scope(f"{self.name or 'vocab_embedding'}_attend"):
+            return jnp.einsum("...h,vh->...v", x,
+                              self.embedding.astype(x.dtype))
 
 # O1 default-cast coverage: TP projections are matmul-class (the
 # FP16_FUNCS row). The layers compute in x.dtype (kernel.astype(x.dtype)

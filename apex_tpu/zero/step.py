@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from apex_tpu.amp import scaler as _scaler_mod
 from apex_tpu.amp.scaler import LossScaler, ScalerState
 from apex_tpu.monitor import hooks as _mon
+from apex_tpu.monitor import profile as _prof
 from apex_tpu.zero import comm as _comm
 from apex_tpu.zero.core import ZeroShardedModel
 
@@ -100,21 +101,29 @@ def make_train_step(
     grad_fn = jax.grad(scaled_loss_fn, has_aux=True)
 
     def step(_mon_on, shards, opt_state, scaler_state: ScalerState, *batch):
-        grads, (loss, aux) = grad_fn(shards, scaler_state, *batch)
-        grads, found_inf = _scaler_mod.unscale(grads, scaler_state,
-                                               out_dtype=grad_dtype)
-        # each rank inspected only its own shards: OR the flag over the
-        # zero axis (and any model-parallel axes) before deciding
-        axes = (zero_model.axis_name,) + tuple(sync_axes)
-        flag = found_inf.astype(jnp.int32)
-        for ax in axes:
-            flag = _comm.psum_flat(flag, ax)
-        found_inf = flag > 0
+        # profile scopes (monitor.profile): metadata-only, jaxpr-pure —
+        # per-phase attribution of the sharded hot loop
+        with _prof.scope("zero_grad"):
+            grads, (loss, aux) = grad_fn(shards, scaler_state, *batch)
+        with _prof.scope("zero_unscale"):
+            grads, found_inf = _scaler_mod.unscale(grads, scaler_state,
+                                                   out_dtype=grad_dtype)
+        with _prof.scope("zero_inf_sync"):
+            # each rank inspected only its own shards: OR the flag over
+            # the zero axis (and any model-parallel axes) before deciding
+            axes = (zero_model.axis_name,) + tuple(sync_axes)
+            flag = found_inf.astype(jnp.int32)
+            for ax in axes:
+                flag = _comm.psum_flat(flag, ax)
+            found_inf = flag > 0
         # zero_model.spec is read at trace time, inside the call: the
         # usual flow builds it (zm.shard) in the same traced program
-        new_shards, new_opt_state = optimizer.apply(
-            opt_state, shards, grads, skip=found_inf, spec=zero_model.spec)
-        new_scaler_state = scaler.update_state(scaler_state, found_inf)
+        with _prof.scope("zero_update"):
+            new_shards, new_opt_state = optimizer.apply(
+                opt_state, shards, grads, skip=found_inf,
+                spec=zero_model.spec)
+        with _prof.scope("zero_scaler"):
+            new_scaler_state = scaler.update_state(scaler_state, found_inf)
         outs = (new_shards, new_opt_state, new_scaler_state, loss)
         return outs + ((aux,) if has_aux else ())
 

@@ -100,6 +100,15 @@ if "xla_force_host_platform_device_count" not in os.environ.get(
 # of being killed holding it. Set BENCH_DEADLINE_S=0 to disable.
 BENCH_DEADLINE_DEFAULT_S = 2700.0
 
+# The FIRST section's budget is capped at this fraction of the global
+# deadline (r05 postmortem: the first section's compile ran long enough
+# to defer its own SIGALRM — Python delivers signals between bytecodes,
+# and one XLA compile is one bytecode — and the whole external budget
+# was gone before a single section finished). With the cap, a
+# worst-case first section still leaves most of the deadline for the
+# rest, so at least one section always completes and flushes evidence.
+FIRST_SECTION_DEADLINE_FRACTION = 0.45
+
 BATCH = 256
 WARMUP = 3
 ITERS = 20
@@ -1667,6 +1676,40 @@ def _bench_autotune():
         "cache_path": cache.path}}
 
 
+def _bench_profile():
+    """Per-module cost attribution evidence (monitor.profile): the
+    analytic attributor over a tiny-GPT amp train step. Same code in
+    smoke and full — the attribution walk is abstract (make_jaxpr;
+    nothing executes), so tiny CPU shapes prove the same property as
+    pod shapes: the package's threaded scopes (TP layers, attention
+    core, amp phases) account for >= 90% of the step's analytic FLOPs.
+    The per-scope rows are recorded into the evidence stream as typed
+    ``profile`` events (``report.aggregate()["profile"]``)."""
+    from apex_tpu.monitor import profile as prof_mod
+
+    # the ONE step recipe shared with `python -m apex_tpu.monitor
+    # profile` (its defaults: tiny GPT, fused_softmax + unfused LM head
+    # so every matmul is visible to the analytic FLOP model — the
+    # flash/CE Pallas kernels trace as pallas_call, which counts
+    # 0 FLOPs, the bench-MFU caveat)
+    step, step_args = prof_mod.demo_train_step("gpt")
+    prof = prof_mod.analytic_profile(step, *step_args, record=True)
+    cov = prof["flops_scope_coverage"]
+    assert cov >= 0.9, \
+        f"scoped-FLOPs coverage {cov:.3f} < 0.9 — a hot path lost its " \
+        f"profile scope (unscoped row: {prof['unscoped']})"
+    top = sorted(prof["scopes"].items(), key=lambda kv: -kv[1]["flops"])
+    return {"profile_flops_scope_coverage": round(cov, 4),
+            "profile_total_flops": int(prof["total"]["flops"]),
+            "profile_total_hbm_bytes": int(prof["total"]["hbm_bytes"]),
+            "profile_n_scopes": len(prof["scopes"]),
+            "profile_top_scopes": [
+                {"scope": name, "flops": int(row["flops"]),
+                 "pct": round(100.0 * row["flops"]
+                              / max(prof["total"]["flops"], 1), 1)}
+                for name, row in top[:6]]}
+
+
 def _bench_gpt_moe():
     """GPT with every-other-block MoE (8 experts, dense mesh —
     single-chip expert compute): the expert-parallel surface's
@@ -1853,6 +1896,48 @@ def _monitor_extras(rec):
 _CONTRACT = {"metric": "resnet50_O2_train_throughput", "value": 0.0,
              "unit": "imgs/sec/chip", "vs_baseline": 0.0}
 
+# Versioned result schema (monitor.regress consumes this): every
+# section event — and the assembled JSON — is stamped with ``schema``
+# and a per-metric ``units`` map, so round-over-round comparison is
+# mechanical and a silent unit change (r01's dispatch-rate "imgs/sec"
+# became r02's device-complete "imgs/sec/chip" with no marker) can
+# never again masquerade as a 50x regression. Additive keys only:
+# every pre-existing JSON key is unchanged.
+RESULT_SCHEMA = 2
+
+# explicit units for the metrics whose name alone is ambiguous —
+# in particular, per-chip vs aggregate is stated, not implied. The
+# rest fall back to the shared regress.suffix_unit name-suffix table.
+_METRIC_UNITS = {
+    "o0_imgs_per_sec": "imgs/sec/chip",
+    "gpt_tokens_per_sec": "tokens/sec (aggregate over 1 chip)",
+    "gpt_s4096_tokens_per_sec": "tokens/sec (aggregate over 1 chip)",
+    "gpt_moe_tokens_per_sec": "tokens/sec (aggregate over 1 chip)",
+    "gpt_moe_top1_tokens_per_sec": "tokens/sec (aggregate over 1 chip)",
+    "bert_tokens_per_sec": "tokens/sec (aggregate over 1 chip)",
+    "vs_baseline": "ratio (O2 vs O0, same chip)",
+    "o1_speedup_vs_o0": "ratio (O1 vs O0, same chip)",
+    "profile_flops_scope_coverage": "fraction",
+}
+
+
+def _section_units(data: dict) -> dict:
+    """Per-metric unit map for one section result (top-level numeric
+    keys only; nested sub-dicts describe themselves)."""
+    from apex_tpu.monitor.regress import suffix_unit
+    units = {}
+    for k, v in data.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        if k == "value" and isinstance(data.get("unit"), str):
+            # the headline declares its own unit; it wins
+            units[k] = data["unit"]
+            continue
+        u = _METRIC_UNITS.get(k) or suffix_unit(k)
+        if u:
+            units[k] = u
+    return units
+
 
 class SectionTimeout(BaseException):
     # BaseException, NOT Exception: section code is full of broad
@@ -1902,7 +1987,8 @@ def _run_section(rec, name: str, fn, budget_s: float, deadline=None):
                     f"timeout: exceeded {budget_s:.0f}s section budget"}
         except Exception as e:
             data = {f"{name}_error": f"{type(e).__name__}: {e}"[:300]}
-    rec.emit("section", name, round(time.monotonic() - t0, 3), data=data)
+    rec.emit("section", name, round(time.monotonic() - t0, 3), data=data,
+             units=_section_units(data), schema=RESULT_SCHEMA)
     return data
 
 
@@ -1922,16 +2008,21 @@ def assemble(stream_path: str) -> dict:
     from apex_tpu.monitor.report import load_jsonl
     _, events = load_jsonl(stream_path)
     out: dict = {}
+    units: dict = {}
     names: list[str] = []
     for ev in events:
         if ev.get("kind") == "section":
             out.update(ev.get("data") or {})
+            units.update(ev.get("units") or {})
             names.append(ev.get("name"))
     if "value" not in out:    # core never completed: contract fallback
         err = out.get("core_error") or \
             "incomplete run: core section missing from evidence stream"
         out = {**_CONTRACT, "error": err, **out}
     out["sections_completed"] = names
+    # versioned-schema stamp (additive; monitor.regress consumes it)
+    out["schema"] = RESULT_SCHEMA
+    out["units"] = units
     return out
 
 
@@ -2041,6 +2132,7 @@ def _sections_full(ctx: dict, rec) -> list:
         ("zero_sharded_step", 300, _bench_zero_sharded),
         ("fp8_step", 300, _bench_fp8_step),
         ("autotune", 120, _bench_autotune),
+        ("profile", 120, _bench_profile),
         ("monitor", 120, lambda: _monitor_extras(rec)),
     ]
     return sections
@@ -2051,7 +2143,7 @@ def _sections_full(ctx: dict, rec) -> list:
 SMOKE_EXPECTED = ("smoke_mlp_amp", "smoke_fused_adam",
                   "smoke_noop_dispatch", "tp_overlap", "ddp_bucket_overlap",
                   "pp_zero_bubble", "zero_sharded_step", "fp8_step",
-                  "autotune", "smoke_timeout_probe", "monitor")
+                  "autotune", "profile", "smoke_timeout_probe", "monitor")
 
 
 def _sections_smoke(ctx: dict, rec) -> list:
@@ -2148,6 +2240,9 @@ def _sections_smoke(ctx: dict, rec) -> list:
         # same code in smoke and full: the fake-clock sweep + cache
         # resolution is deterministic and deviceless by design
         ("autotune", 120, _bench_autotune),
+        # same code in smoke and full: the attribution walk is abstract
+        # (make_jaxpr — nothing executes), tiny shapes prove coverage
+        ("profile", 120, _bench_profile),
         ("smoke_timeout_probe", probe_budget, timeout_probe),
         ("monitor", 60, lambda: _monitor_extras(rec)),
     ]
@@ -2241,8 +2336,24 @@ def main(argv=None) -> int:
 
     sections = _sections_smoke(ctx, rec) if args.smoke \
         else _sections_full(ctx, rec)
+    # r05 postmortem, part 2: that round died under the external timeout
+    # with NOTHING in its tail but the platform warning — the very first
+    # section's compile ate the whole budget before any evidence line
+    # reached stdout/stderr. Two fixes here: (a) a flushed `started`
+    # line (stream + stderr) BEFORE the first compile, and a per-section
+    # heartbeat before each section, so a killed run's tail always shows
+    # how far it got; (b) the FIRST section's budget is additionally
+    # capped to a fraction of the deadline, so even when one compile
+    # blocks signal delivery for its whole budget, the remaining
+    # sections still fit under the deadline and at least one more
+    # completes.
+    rec.emit("started", "bench", len(sections),
+             sections=[s[0] for s in sections],
+             smoke=bool(args.smoke), deadline_s=deadline_s)
+    print(f"bench: started ({len(sections)} sections, deadline "
+          f"{deadline_s:.0f}s)", file=sys.stderr, flush=True)
     try:
-        for name, budget, fn in sections:
+        for i, (name, budget, fn) in enumerate(sections):
             budget_s = budget * args.budget_scale
             if deadline is not None:
                 # derive every section's SIGALRM budget from the global
@@ -2252,6 +2363,14 @@ def main(argv=None) -> int:
                 # signal-delivery deferral)
                 budget_s = min(budget_s,
                                max(deadline - time.monotonic(), 0.01))
+                if i == 0:
+                    budget_s = min(budget_s,
+                                   FIRST_SECTION_DEADLINE_FRACTION
+                                   * deadline_s)
+            rec.emit("section_start", name, i,
+                     budget_s=round(budget_s, 1))
+            print(f"bench: [{i + 1}/{len(sections)}] {name} "
+                  f"(budget {budget_s:.0f}s)", file=sys.stderr, flush=True)
             _run_section(rec, name, fn, budget_s, deadline)
     finally:
         if prev_term is not None:

@@ -24,6 +24,11 @@
 #                    timed-out one) landed in the flushed JSONL — the
 #                    guard against a repeat of the r5 evidence loss
 #                    (BENCH_r05.json: rc=124, parsed: null)
+#   5. regress     — python -m apex_tpu.monitor regress: the smoke
+#                    stream must load as an evidence round, and the
+#                    committed BENCH_r01-r05 rounds must degrade exactly
+#                    as documented (r05 no-evidence, r01 incomparable)
+#                    with no false regression verdict
 set -uo pipefail
 cd "$(dirname "$0")/.."
 REPO_DIR="$(pwd)"
@@ -49,12 +54,12 @@ echo "== ci: bench streaming-evidence smoke =="
     BENCH_STREAM_PATH=/tmp/ci_bench_smoke_stream.jsonl \
     python "$REPO_DIR/bench.py" --smoke > /tmp/ci_bench_smoke.json ) || fail=1
 
-echo "== ci: overlap + zero-bubble + zero-sharded + fp8 + autotune bench sections in the evidence stream =="
+echo "== ci: overlap + zero-bubble + zero-sharded + fp8 + autotune + profile bench sections in the evidence stream =="
 # the PR-4 overlap sections, the PR-5 pp_zero_bubble section, the
-# PR-6 zero_sharded_step section, the PR-7 fp8_step section and the
-# PR-8 autotune section must land as flushed section lines
-# (bench --smoke already asserts SMOKE_EXPECTED; this is the
-# independent driver-side check of the same contract)
+# PR-6 zero_sharded_step section, the PR-7 fp8_step section, the
+# PR-8 autotune section and the PR-10 profile section must land as
+# flushed section lines (bench --smoke already asserts SMOKE_EXPECTED;
+# this is the independent driver-side check of the same contract)
 python - /tmp/ci_bench_smoke_stream.jsonl <<'EOF' || fail=1
 import json, sys
 seen = set()
@@ -63,12 +68,42 @@ for line in open(sys.argv[1]):
     if ev.get("kind") == "section":
         seen.add(ev.get("name"))
 missing = {"tp_overlap", "ddp_bucket_overlap", "pp_zero_bubble",
-           "zero_sharded_step", "fp8_step", "autotune"} - seen
+           "zero_sharded_step", "fp8_step", "autotune", "profile"} - seen
 if missing:
     print(f"ci: sections missing from bench stream: {sorted(missing)}")
     raise SystemExit(1)
 print("ci: tp_overlap + ddp_bucket_overlap + pp_zero_bubble + "
-      "zero_sharded_step + fp8_step + autotune present in bench stream")
+      "zero_sharded_step + fp8_step + autotune + profile present in "
+      "bench stream")
+EOF
+
+echo "== ci: bench-trajectory regression gate (monitor.regress) =="
+# 1) the smoke stream must load as an evidence round without crashing
+#    (single round: nothing to compare, but the loader + schema stamp
+#    are exercised on every CI run)
+python -m apex_tpu.monitor regress /tmp/ci_bench_smoke_stream.jsonl \
+    --json > /tmp/ci_regress_smoke.json || fail=1
+# 2) the committed rounds must degrade exactly as documented: r05 is
+#    a no-evidence row (rc=124), r01 is incomparable with r02+ (the
+#    unit-methodology change), and no false regression fires
+python - <<'EOF' || fail=1
+import json, subprocess, sys
+p = subprocess.run(
+    [sys.executable, "-m", "apex_tpu.monitor", "regress",
+     *[f"BENCH_r0{i}.json" for i in range(1, 6)], "--json"],
+    capture_output=True, text=True)
+if p.returncode != 0:
+    print(f"ci: regress over committed rounds exited {p.returncode}:\n"
+          f"{p.stdout}\n{p.stderr}")
+    raise SystemExit(1)
+rep = json.loads(p.stdout)
+by = {r["round"]: r for r in rep["rounds"]}
+assert by["r05"]["status"] == "no-evidence", by["r05"]
+inc = rep["metrics"]["value"].get("incomparable") or []
+assert any(i["round"] == "r01" for i in inc), rep["metrics"]["value"]
+assert not rep["regressions"], rep["regressions"]
+print("ci: regress gate ok (r05 no-evidence, r01 incomparable, "
+      "no false regressions)")
 EOF
 
 if [[ "$fail" == "0" ]]; then

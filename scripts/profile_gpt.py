@@ -1,44 +1,22 @@
-"""One-off: full per-op table for the GPT bench step (round-4 CE work).
+"""Thin wrapper: per-module + per-op profile of the GPT bench step.
+
+The round-4 one-off this script used to be is now the ``profile``
+subcommand of the monitor CLI (``python -m apex_tpu.monitor profile``,
+docs/perf.md "Profiling your model"): analytic per-module attribution
+by default, ``--per-op`` for the XProf per-op table this script
+originally printed. This wrapper pins the GPT bench shapes.
 
 Usage: PYTHONPATH=/root/.axon_site:/root/repo python scripts/profile_gpt.py
 """
-import tempfile
+import sys
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+from apex_tpu.monitor.__main__ import main
 
-from apex_tpu.models import GPT, GPTConfig
-from apex_tpu.transformer import parallel_state as ps
-from apex_tpu.pyprof import parse as pparse, trace as ptrace
-
-ps.destroy_model_parallel()
-b, s = 8, 1024
-cfg = GPTConfig(vocab_size=32768, max_seq_len=s, hidden_size=1024,
-                num_layers=12, num_heads=16, dtype=jnp.bfloat16)
-model = GPT(cfg)
-rng = np.random.RandomState(0)
-ids = jnp.asarray(rng.randint(0, 32768, (b, s)), jnp.int32)
-labels = jnp.asarray(np.roll(np.asarray(ids), -1, 1))
-v = model.init(jax.random.PRNGKey(0), ids)
-
-
-@jax.jit
-def step(v, ids, labels):
-    return jax.value_and_grad(lambda v: model.loss(v, ids, labels))(v)
-
-
-out = step(v, ids, labels)
-float(out[0])
-d = tempfile.mkdtemp(prefix="gptprof_")
-with ptrace(d):
-    float(step(v, ids, labels)[0])
-
-rows = pparse.op_stats(d)
-tot = sum(r["total_self_time_us"] or 0 for r in rows)
-print(f"total device self time: {tot/1e3:.2f} ms")
-print(f"{'self_us':>10} {'pct':>6} {'bound':>8}  operation")
-for r in rows[:45]:
-    print(f"{r['total_self_time_us'] or 0:10.0f} "
-          f"{r['device_self_time_pct'] or 0:6.2f} "
-          f"{str(r['bound_by'] or ''):>8}  {r['operation'][:110]}")
+if __name__ == "__main__":
+    sys.exit(main([
+        "profile", "--model", "gpt", "--batch", "8", "--seq", "1024",
+        "--hidden", "1024", "--layers", "12", "--heads", "16",
+        "--vocab", "32768", "--dtype", "bfloat16",
+        "--attention", "flash", "--fused-lm-head", "--per-op",
+        *sys.argv[1:],
+    ]))

@@ -51,6 +51,29 @@ def test_bench_smoke_stream_has_all_sections(tmp_path):
     assert sections == list(bench.SMOKE_EXPECTED), sections
     # monitor telemetry (compile timers) streamed alongside
     assert any(e["kind"] == "timer" for e in events)
+    # versioned result schema: the assembled JSON and every section
+    # line carry schema + per-metric units (additive keys)
+    assert out["schema"] == bench.RESULT_SCHEMA
+    assert out["units"]["smoke_fused_adam_ms"] == "ms"
+    assert out["units"]["value"] == "steps/sec"    # declared unit wins
+    for e in events:
+        if e["kind"] == "section":
+            assert e["schema"] == bench.RESULT_SCHEMA, e
+    # the profile section: the threaded scopes account for >= 90% of
+    # the tiny-GPT step's analytic FLOPs (acceptance bound)
+    assert out["profile_flops_scope_coverage"] >= 0.9, out
+    # r05-hole satellites: header + flushed `started` roster precede
+    # every section, and each section is announced by a section_start
+    # heartbeat (stream AND stderr), so a killed run's tail always
+    # shows progress
+    kinds = [e["kind"] for e in events]
+    assert kinds[0] == "header"
+    assert kinds.index("started") < kinds.index("section_start") \
+        < kinds.index("section")
+    starts = [e["name"] for e in events if e["kind"] == "section_start"]
+    assert starts == list(bench.SMOKE_EXPECTED)
+    assert "bench: started" in proc.stderr
+    assert "bench: [1/" in proc.stderr
 
 
 def test_bench_sigterm_preserves_completed_sections(tmp_path):
@@ -71,7 +94,10 @@ def test_bench_sigterm_preserves_completed_sections(tmp_path):
             try:
                 with open(stream) as f:
                     txt = f.read()
-                if '"smoke_noop_dispatch"' in txt:
+                # the COMPLETED-section line, not the `started` roster
+                # or the section_start heartbeat that now precede it
+                if '"kind": "section", "name": "smoke_noop_dispatch"' \
+                        in txt:
                     break
             except FileNotFoundError:
                 pass
@@ -183,6 +209,16 @@ def test_bench_full_set_default_deadline_self_finishes(tmp_path):
         data = e.get("data") or {}
         assert any(k.endswith("_error") or k.endswith("_skipped")
                    for k in data), data
+    # the FIRST section's budget is additionally capped at a fraction
+    # of the deadline (r05: one long compile deferred its own SIGALRM
+    # and ate the whole external budget before any section finished)
+    first_start = next(e for e in events if e["kind"] == "section_start")
+    assert first_start["name"] == full_names[0]
+    assert first_start["budget_s"] <= \
+        bench.FIRST_SECTION_DEADLINE_FRACTION * 3 + 0.05, first_start
+    # the started roster was flushed before any section ran
+    assert [e["kind"] for e in events].index("started") < \
+        [e["kind"] for e in events].index("section")
 
 
 def test_default_deadline_resolution():
