@@ -460,6 +460,60 @@ def _profiled_train_step():
     return fn, args, allowed
 
 
+def _serve_decode_step():
+    """The serve decode step under tp=2: one token per batch slot
+    through the TP layers with the paged KV cache sharded along heads
+    over the tensor axis (``serve.rules.CACHE_RULES``). The collectives
+    — the row-parallel psums behind proj/fc2 and the full-vocab logits
+    gather — must ride the canonical tensor axis: a typo'd axis in the
+    serve path would trace clean and deadlock (or silently drop the
+    reduction) on the pod."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from apex_tpu._compat import shard_map
+    from apex_tpu.models.gpt import GPT, GPTConfig
+    from apex_tpu.serve import cache as cache_mod
+    from apex_tpu.serve import model as serve_model
+    from apex_tpu.serve import rules as serve_rules
+
+    cfg = GPTConfig(vocab_size=32, max_seq_len=32, hidden_size=16,
+                    num_layers=1, num_heads=2, dtype=jnp.float32)
+    # init at tp=1 (full layout) BEFORE installing the tp=2 mesh: the
+    # serve convention is a full param tree split by the in_specs
+    from apex_tpu.transformer import parallel_state as ps
+    ps.destroy_model_parallel()
+    params = GPT(cfg).init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))["params"]
+    mesh, tp, _ = _mesh_for(tp=2)
+    ccfg = cache_mod.CacheConfig(num_layers=1, kv_heads=2, head_dim=8,
+                                 num_pages=4, page_size=8)
+    state = cache_mod.init_cache(ccfg)
+
+    def decode(params, state, bt, pos, tok, act):
+        logits, state = serve_model.decode_forward(
+            cfg, ccfg, params, state, bt, pos, tok, act,
+            paged_impl="reference")
+        return logits, state
+
+    pspec = serve_rules.match_serve_rules(serve_rules.GPT_PARAM_RULES,
+                                          params, world=tp)
+    cspec = serve_rules.match_serve_rules(serve_rules.CACHE_RULES,
+                                          state, world=tp)
+    inner = shard_map(decode, mesh=mesh,
+                      in_specs=(pspec, cspec, P(), P(), P(), P()),
+                      out_specs=(P(), cspec), check_vma=False)
+    # donate_argnums=() is the APX007 conscious opt-out: this entrypoint
+    # is traced abstractly by the lint gate only — the REAL serve step
+    # (ServeEngine._build_steps) donates the cache pytree
+    fn = jax.jit(inner, donate_argnums=())
+    bt = jnp.zeros((2, 2), jnp.int32)
+    pos = jnp.zeros((2,), jnp.int32)
+    tok = jnp.zeros((2,), jnp.int32)
+    act = jnp.ones((2,), bool)
+    return fn, (params, state, bt, pos, tok, act), mesh.axis_names
+
+
 def _fused_lm_head_ce():
     """Vocab-parallel fused LM-head CE: the pmax/psum trio over the
     tensor axis, plus the Pallas kernels in interpret mode."""
@@ -498,4 +552,5 @@ register_entrypoint("zero3_train_step", _zero3_train_step)
 register_entrypoint("fp8_train_step", _fp8_train_step)
 register_entrypoint("flash_attention_tuned_step", _flash_attention_tuned_step)
 register_entrypoint("profiled_train_step", _profiled_train_step)
+register_entrypoint("serve_decode_step", _serve_decode_step)
 register_entrypoint("fused_lm_head_ce", _fused_lm_head_ce)

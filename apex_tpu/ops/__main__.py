@@ -20,8 +20,10 @@ Examples::
 
 Shape specs are ``key=value`` comma lists — flash: ``b,h,s`` (or
 ``sq``/``sk``), ``d``, ``dtype``, ``causal/bias/dropout/segments``;
-lm_head_ce: ``n,v,h,dtype,smoothing``. Flash sweeps tune the forward
-and backward INDEPENDENTLY (two cache entries per shape).
+lm_head_ce: ``n,v,h,dtype,smoothing``; decode_attention (the serve
+KV-cache page-size sweep): ``b,kv,group,s,d,dtype,fp8``. Flash sweeps
+tune the forward and backward INDEPENDENTLY (two cache entries per
+shape).
 """
 
 from __future__ import annotations
@@ -36,8 +38,8 @@ def _cmd_tune(args) -> int:
     from apex_tpu.tune.cache import TuneCache
 
     cache = TuneCache(directory=args.cache)
-    kernels = (["flash_attention", "lm_head_ce"] if args.kernel == "all"
-               else [args.kernel])
+    kernels = (["flash_attention", "lm_head_ce", "decode_attention"]
+               if args.kernel == "all" else [args.kernel])
     if args.list:
         print(f"cache: {cache.path} (device_kind={cache.device_kind})")
         for key, row in sorted(cache.entries().items()):
@@ -73,7 +75,7 @@ def _cmd_tune(args) -> int:
         specs = (per_kernel[kernel] if args.shapes
                  else tk.DEFAULT_SHAPES[kernel])
         phases = (["flash_attention_fwd", "flash_attention_bwd"]
-                  if kernel == "flash_attention" else ["lm_head_ce"])
+                  if kernel == "flash_attention" else [kernel])
         for spec in specs:
             for phase in phases:
                 if not args.json:
@@ -111,7 +113,8 @@ def main(argv=None) -> int:
     sub = p.add_subparsers(dest="cmd", required=True)
     t = sub.add_parser("tune", help="measure-and-cache block autotuning")
     t.add_argument("--kernel", default="all",
-                   choices=["all", "flash_attention", "lm_head_ce"])
+                   choices=["all", "flash_attention", "lm_head_ce",
+                            "decode_attention"])
     t.add_argument("--shapes", action="append", metavar="SPEC",
                    help="key=value,... shape spec (repeatable); default: "
                         "the bench model shapes")

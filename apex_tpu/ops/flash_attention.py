@@ -919,6 +919,225 @@ def _fa_bwd(causal, scale, dropout_rate, block_q, block_k,
 
 _flash_attention.defvjp(_fa_fwd, _fa_bwd)
 
+# ---------------------------------------------------------------------------
+# Paged decode attention (the serve path): one query token per sequence
+# reading K/V through a block table over a preallocated page pool.
+# ---------------------------------------------------------------------------
+#
+# Layout contract (shared with apex_tpu.serve.cache):
+#   q            [b, kv_heads, group, d]   (group = q_heads // kv_heads; GQA.
+#                                           MHA is group == 1)
+#   k/v pages    [kv_heads, num_pages, page_size, d]
+#   block_tables [b, pages_per_seq] int32  (pool page ids; page 0 is the
+#                                           null page — entries past the
+#                                           sequence length point there and
+#                                           are masked by seq_lens)
+#   seq_lens     [b] int32                 (0 = inactive slot: zero output)
+#   k/v_scales   [kv_heads, num_pages] f32 (fp8-KV mode: the per-page
+#                                           quantize multiplier of
+#                                           amp.fp8 — dequant divides it
+#                                           back out in-kernel)
+#
+# The kernel grid is (b, kv_heads, pages_per_seq): each program loads ONE
+# page of one head for one sequence (page id resolved from the
+# scalar-prefetched block table, the Pallas TPU paged-attention pattern)
+# and accumulates online-softmax state exactly like the training forward
+# kernel above. There is no backward: decode is inference-only.
+#
+# The page size IS this kernel's block size; it is fixed when the pool is
+# allocated, so resolution (explicit > tuned cache > heuristic, the
+# fwd/bwd policy) happens in ``serve.cache.resolve_page_size`` at pool
+# construction rather than per call.
+
+
+def paged_attention_reference(q, k_pages, v_pages, block_tables, seq_lens,
+                              *, scale=None, k_scales=None, v_scales=None):
+    """Pure-XLA paged decode attention — the parity baseline and the
+    off-TPU serving path (gathers pages through the block table; O(b *
+    pages_per_seq * page_size) memory, fine at decode's one-query
+    shapes)."""
+    kv_heads, _, page_size, d = k_pages.shape
+    b, _, _, _ = q.shape
+    m = block_tables.shape[1]
+    scale = d ** -0.5 if scale is None else scale
+    # [kv, b, m, bs, d] -> [b, kv, m*bs, d]
+    k = jnp.take(k_pages, block_tables, axis=1).transpose(1, 0, 2, 3, 4)
+    v = jnp.take(v_pages, block_tables, axis=1).transpose(1, 0, 2, 3, 4)
+    k = k.astype(jnp.float32).reshape(b, kv_heads, m * page_size, d)
+    v = v.astype(jnp.float32).reshape(b, kv_heads, m * page_size, d)
+    if k_scales is not None:
+        ks = jnp.take(k_scales, block_tables, axis=1).transpose(1, 0, 2)
+        k = k / jnp.repeat(ks, page_size, axis=2)[..., None]
+    if v_scales is not None:
+        vs = jnp.take(v_scales, block_tables, axis=1).transpose(1, 0, 2)
+        v = v / jnp.repeat(vs, page_size, axis=2)[..., None]
+    s = jnp.einsum("bkgd,bksd->bkgs", q.astype(jnp.float32), k) * scale
+    pos = jnp.arange(m * page_size, dtype=jnp.int32)
+    live = pos[None, :] < seq_lens[:, None]              # [b, m*bs]
+    s = jnp.where(live[:, None, None, :], s, _NEG_INF)
+    mx = jnp.maximum(jnp.max(s, axis=-1, keepdims=True), _NEG_INF)
+    p = jnp.exp(s - mx)
+    p = jnp.where(live[:, None, None, :], p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bkgs,bksd->bkgd", p, v) / jnp.where(l > 0, l, 1.0)
+    return out.astype(q.dtype)
+
+
+def _paged_decode_kernel(*refs, scale, page_size, group, fp8, pages_per_seq):
+    it = iter(refs)
+    bt_ref = next(it)                       # scalar prefetch: [b*m] int32
+    sl_ref = next(it)                       # scalar prefetch: [b] int32
+    ks_ref = next(it) if fp8 else None      # SMEM [kv, num_pages] f32
+    vs_ref = next(it) if fp8 else None
+    q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr = it
+
+    bi, kh, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        k = k_ref[0, 0]                                   # [bs, d]
+        if fp8:
+            idx = bt_ref[bi * pages_per_seq + j]
+            q = q_ref[0, 0].astype(jnp.float32)
+            k = k.astype(jnp.float32)
+        else:
+            q = q_ref[0, 0]                               # [g8, d]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if fp8:
+            # dequant: stored pages are clip(x * page_scale); the scale
+            # guards in amp.fp8.compute_scale keep every stored scale
+            # finite and positive, so the divides are safe even for the
+            # null page
+            s = s / ks_ref[kh, idx]
+        s = s * scale
+        pos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (group, page_size), 1)
+        mask = pos < sl_ref[bi]
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        # rows whose max is the masked fill (partially-dead pages, the
+        # padded group rows): exp(-1e30 - (-1e30)) = 1, not 0
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, 0]
+        if fp8:
+            pv = jax.lax.dot_general(
+                p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) / vs_ref[kh, idx]
+        else:
+            pv = jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = m_new
+
+    # dead pages (fully past the sequence end — including every page of
+    # an inactive slot, whose table points at the null page) skip the
+    # compute entirely; init/finalize still run, so the output block is
+    # always written (zeros for a fully-dead sequence)
+    pl.when(j * page_size < sl_ref[bi])(_compute)
+
+    @pl.when(j == pages_per_seq - 1)
+    def _finish():
+        l = l_scr[:]
+        o_ref[0, 0] = (acc_scr[:] / jnp.where(l > 0, l, 1.0)
+                       ).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_tables, seq_lens, *,
+                           scale: Optional[float] = None,
+                           k_scales=None, v_scales=None,
+                           interpret: Optional[bool] = None):
+    """Paged single-query (decode) attention, GQA-aware. Returns
+    ``[b, kv_heads, group, d]`` in ``q.dtype``.
+
+    See the layout contract above. ``k_scales``/``v_scales`` arm the
+    fp8-KV mode: pages hold e4m3 values quantized per page with the
+    amp.fp8 codec and the kernel dequantizes in-VMEM — the pool in HBM
+    stays 1 byte/element. Scales ride in SMEM (4 B per page per head).
+
+    Off-TPU the kernel runs in Pallas interpret mode (same contract as
+    :func:`flash_attention`); ``apex_tpu.serve`` uses
+    :func:`paged_attention_reference` there instead, which is faster
+    under XLA CPU.
+    """
+    b, kv_heads, group, d = q.shape
+    kvp, num_pages, page_size, dp = k_pages.shape
+    if (kvp, dp) != (kv_heads, d):
+        raise ValueError(
+            f"k_pages {k_pages.shape} does not match q {q.shape}: want "
+            f"[kv_heads={kv_heads}, num_pages, page_size, d={d}]")
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("fp8-KV mode needs BOTH k_scales and v_scales")
+    if page_size % 8:
+        # the page is the kernel's sublane block extent; the tune menu
+        # and serve.cache's heuristic are both 8-aligned, but an
+        # explicit page_size can reach here unrounded — fail with the
+        # contract rather than a Mosaic tiling error
+        raise ValueError(
+            f"page_size {page_size} must be a multiple of 8 (the Pallas "
+            f"sublane tile); use the reference path for odd pools")
+    fp8 = k_scales is not None
+    m = block_tables.shape[1]
+    scale_v = d ** -0.5 if scale is None else scale
+    # pad the group (query-heads-per-kv-head) dim up to the 8-sublane
+    # tile; padded rows cost dead VPU lanes, not correctness (masked
+    # rows normalize to zeros and are sliced away)
+    g8 = max(8, -(-group // 8) * 8)
+    if g8 != group:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, g8 - group), (0, 0)))
+
+    kernel = functools.partial(
+        _paged_decode_kernel, scale=scale_v, page_size=page_size,
+        group=g8, fp8=fp8, pages_per_seq=m)
+
+    def page_map(bi, kh, j, bt, sl):
+        return (kh, bt[bi * m + j], 0, 0)
+
+    in_specs = []
+    operands = []
+    if fp8:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.SMEM),
+                     pl.BlockSpec(memory_space=pltpu.SMEM)]
+        operands += [k_scales, v_scales]
+    in_specs += [
+        pl.BlockSpec((1, 1, g8, d), lambda bi, kh, j, bt, sl: (bi, kh, 0, 0)),
+        pl.BlockSpec((1, 1, page_size, d), page_map),
+        pl.BlockSpec((1, 1, page_size, d), page_map),
+    ]
+    operands += [q, k_pages, v_pages]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kv_heads, m),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, g8, d),
+                               lambda bi, kh, j, bt, sl: (bi, kh, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((g8, 1), jnp.float32),
+                        pltpu.VMEM((g8, 1), jnp.float32),
+                        pltpu.VMEM((g8, d), jnp.float32)],
+    )
+    from apex_tpu.monitor import profile as _prof
+    with _prof.scope("paged_decode_attention"):
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, kv_heads, g8, d), q.dtype),
+            interpret=_resolve_interpret(interpret),
+        )(block_tables.reshape(-1).astype(jnp.int32),
+          seq_lens.astype(jnp.int32), *operands)
+    return out[:, :, :group]
+
+
 from apex_tpu.amp.policy import half_function  # noqa: E402  (amp has no ops imports; placed here to keep kernel code import-light)
 
 

@@ -1,0 +1,233 @@
+"""Paged KV cache: a preallocated page pool + per-sequence block tables.
+
+The pool is allocated ONCE (``init_cache``) and never reshaped: every
+cache mutation is a scatter into the fixed arrays, so the decode step
+can donate the pool and update it in place. Layout (the
+``ops.flash_attention.paged_decode_attention`` contract):
+
+    k_pool / v_pool   [num_layers, kv_heads, num_pages, page_size, d]
+    k_scale / v_scale [num_layers, kv_heads, num_pages]  f32 (fp8 mode)
+
+Page 0 is the **null page**: the host allocator never hands it out, and
+every masked write (inactive batch slots, prompt padding) is routed to
+it — so a scatter never needs a branch, and nothing ever reads the null
+page's contents (block-table entries past a sequence's length point at
+it but are masked by ``seq_lens``).
+
+fp8-KV mode stores e4m3 pages through the :mod:`apex_tpu.amp.fp8` codec
+with ONE scale per (layer, head, page), fixed when the page's slot-0
+token is written (``compute_scale`` of that token's amax with
+``fp8_margin`` powers of two of headroom; later tokens in the page
+quantize with the same scale and saturate-clip past it — the e4m3 clip
+is the codec's correctness rule). The slot-0 rule is what makes
+evict/re-admit bit-exact: a page's scale is a deterministic function of
+its first token regardless of whether that token arrived via prefill or
+decode, so a recomputed cache is bitwise the original.
+
+Page size resolves **explicit > tuned cache > heuristic** through
+``apex_tpu.tune`` (:func:`resolve_page_size` — the ``decode_attention``
+sweep of ``python -m apex_tpu.ops tune``), exactly like the flash
+fwd/bwd blocks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import fp8 as fp8_mod
+
+#: heuristic default page size: big enough that a 1k-token context is
+#: 8 pages (program-count bound, like the flash forward), small enough
+#: that the per-sequence tail waste (page_size/2 tokens average) stays
+#: a few percent at chat lengths
+DEFAULT_PAGE_SIZE = 128
+
+
+def resolve_page_size(*, kv_heads: int, head_dim: int, context_len: int,
+                      group: int = 1, dtype=jnp.bfloat16, fp8: bool = False,
+                      batch: int = 1, page_size: Optional[int] = None,
+                      autotune: Optional[str] = None) -> int:
+    """Pool page size: explicit > tuned cache > heuristic (the flash
+    fwd/bwd resolution order, via the ``decode_attention`` sweep)."""
+    if page_size is not None:
+        return int(page_size)
+    from apex_tpu.tune import runtime as tune_rt
+    policy = tune_rt.resolve_policy(autotune)
+    if policy != "off":
+        dt = jnp.dtype(dtype)
+        shape = {"b": batch, "kv": kv_heads, "group": group,
+                 "s": context_len, "d": head_dim, "itemsize": dt.itemsize}
+        cfg = tune_rt.resolve("decode_attention", shape, dt.name,
+                              {"fp8": bool(fp8)}, policy=policy)
+        if cfg is not None:
+            return int(cfg["block_kv"])
+    # clip to the context like flash blocks clip to the sequence, but
+    # keep the 8-sublane alignment the Pallas kernel requires
+    return min(DEFAULT_PAGE_SIZE, max(8, -(-context_len // 8) * 8))
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheConfig:
+    """Static pool geometry (hashable — rides jit as a static arg)."""
+
+    num_layers: int
+    kv_heads: int
+    head_dim: int
+    num_pages: int                 # INCLUDING the null page 0
+    page_size: int
+    dtype: Any = jnp.bfloat16      # pool dtype (ignored when fp8)
+    fp8: bool = False
+    fp8_margin: float = 2.0        # 2**margin headroom over the slot-0 amax
+
+    def __post_init__(self):
+        if self.num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is the "
+                             "reserved null page)")
+        if self.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {self.page_size}")
+
+    @property
+    def pool_dtype(self):
+        return fp8_mod.E4M3 if self.fp8 else jnp.dtype(self.dtype)
+
+    @property
+    def usable_pages(self) -> int:
+        return self.num_pages - 1
+
+    def pages_for_tokens(self, n: int) -> int:
+        return -(-int(n) // self.page_size)
+
+    # -- capacity accounting (host-side ints: the bench/test assertions
+    #    about fp8 capacity come from HERE, not from hand-waving) ------
+
+    def bytes_per_page(self) -> int:
+        """HBM bytes one pool page costs across k+v (+ fp8 scales)."""
+        elems = self.kv_heads * self.page_size * self.head_dim
+        per = 2 * elems * jnp.dtype(self.pool_dtype).itemsize
+        if self.fp8:
+            per += 2 * self.kv_heads * 4          # k_scale + v_scale rows
+        return per * self.num_layers
+
+    def pool_bytes(self) -> int:
+        return self.bytes_per_page() * self.num_pages
+
+    def pages_in_budget(self, budget_bytes: int) -> int:
+        return int(budget_bytes) // self.bytes_per_page()
+
+    def max_concurrent_seqs(self, budget_bytes: int, seq_len: int) -> int:
+        """How many ``seq_len``-token sequences fit a pool of
+        ``budget_bytes`` (minus the null page)."""
+        usable = max(0, self.pages_in_budget(budget_bytes) - 1)
+        return usable // self.pages_for_tokens(seq_len)
+
+
+class CacheState(NamedTuple):
+    """The device pytree the jitted steps thread and donate."""
+
+    k_pool: jax.Array
+    v_pool: jax.Array
+    k_scale: Optional[jax.Array]   # None outside fp8 mode
+    v_scale: Optional[jax.Array]
+
+
+def init_cache(cfg: CacheConfig) -> CacheState:
+    shape = (cfg.num_layers, cfg.kv_heads, cfg.num_pages, cfg.page_size,
+             cfg.head_dim)
+    k = jnp.zeros(shape, cfg.pool_dtype)
+    v = jnp.zeros(shape, cfg.pool_dtype)
+    if not cfg.fp8:
+        return CacheState(k, v, None, None)
+    # scales init to 1.0: finite and positive everywhere, so the
+    # kernel's dequant divides are safe even for never-written pages.
+    # Two DISTINCT arrays — aliased leaves break the donated step
+    # (donate-same-buffer-twice)
+    sshape = (cfg.num_layers, cfg.kv_heads, cfg.num_pages)
+    return CacheState(k, v, jnp.ones(sshape, jnp.float32),
+                      jnp.ones(sshape, jnp.float32))
+
+
+def _page_scales(cfg: CacheConfig, x) -> jax.Array:
+    """compute_scale over the head dim: ``x`` [..., kv, d] ->
+    [..., kv]."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    return fp8_mod.compute_scale(amax, fp8_mod.E4M3_MAX,
+                                 margin=cfg.fp8_margin)
+
+
+def write_token(cfg: CacheConfig, state: CacheState, layer: int,
+                page_ids, slots, k_new, v_new) -> CacheState:
+    """Scatter one decode token per batch slot into layer ``layer``.
+
+    ``page_ids``/``slots``: int32 [b] (masked slots carry page 0);
+    ``k_new``/``v_new``: [b, kv_heads, d]. Pure — runs inside the
+    donated decode step.
+    """
+    # NB indexing below mixes the scalar ``layer`` with index arrays:
+    # both are "advanced" indices separated by the heads slice, so the
+    # broadcast dims land FIRST — gathers/scatters see [b, kv, ...]
+    k_t, v_t = k_new, v_new                        # [b, kv, d]
+    k_scale = state.k_scale
+    v_scale = state.v_scale
+    if cfg.fp8:
+        first = (slots == 0)[:, None]              # [b, 1]
+        cand_k = _page_scales(cfg, k_new)          # [b, kv]
+        cand_v = _page_scales(cfg, v_new)
+        cur_k = state.k_scale[layer, :, page_ids]  # [b, kv]
+        cur_v = state.v_scale[layer, :, page_ids]
+        sk = jnp.where(first, cand_k, cur_k)
+        sv = jnp.where(first, cand_v, cur_v)
+        k_scale = state.k_scale.at[layer, :, page_ids].set(sk)
+        v_scale = state.v_scale.at[layer, :, page_ids].set(sv)
+        k_t = fp8_mod.quantize(k_t, sk[..., None], fp8_mod.E4M3)
+        v_t = fp8_mod.quantize(v_t, sv[..., None], fp8_mod.E4M3)
+    else:
+        k_t = k_t.astype(cfg.pool_dtype)
+        v_t = v_t.astype(cfg.pool_dtype)
+    k_pool = state.k_pool.at[layer, :, page_ids, slots].set(k_t)
+    v_pool = state.v_pool.at[layer, :, page_ids, slots].set(v_t)
+    return CacheState(k_pool, v_pool, k_scale, v_scale)
+
+
+def write_prompt(cfg: CacheConfig, state: CacheState, layer: int,
+                 block_table, length, k_seq, v_seq) -> CacheState:
+    """Scatter a whole (padded) prompt's K/V for one sequence.
+
+    ``block_table``: int32 [m] (the sequence's pages); ``length``:
+    traced scalar (real prompt length — positions past it route to the
+    null page); ``k_seq``/``v_seq``: [S, kv_heads, d] with S static and
+    a multiple-free shape (S <= m * page_size).
+    """
+    S = k_seq.shape[0]
+    pos = jnp.arange(S, dtype=jnp.int32)
+    live = pos < length
+    pages = jnp.where(live, block_table[pos // cfg.page_size], 0)
+    slots = pos % cfg.page_size
+    # advanced-indexing note as in write_token: [S, kv, ...] layouts
+    k_t, v_t = k_seq, v_seq                        # [S, kv, d]
+    k_scale = state.k_scale
+    v_scale = state.v_scale
+    if cfg.fp8:
+        # slot-0 rule: one scale write per touched page, from the
+        # page's first token (static stride — S and page_size are
+        # static), identical to what the decode write would have set
+        pos0 = jnp.arange(0, S, cfg.page_size, dtype=jnp.int32)
+        pages0 = pages[pos0]                       # masked ones hit null
+        sk0 = _page_scales(cfg, k_seq[pos0])       # [m_used, kv]
+        sv0 = _page_scales(cfg, v_seq[pos0])
+        k_scale = state.k_scale.at[layer, :, pages0].set(sk0)
+        v_scale = state.v_scale.at[layer, :, pages0].set(sv0)
+        # every position quantizes with ITS page's (new) scale
+        sk = k_scale[layer, :, pages]              # [S, kv]
+        sv = v_scale[layer, :, pages]
+        k_t = fp8_mod.quantize(k_t, sk[..., None], fp8_mod.E4M3)
+        v_t = fp8_mod.quantize(v_t, sv[..., None], fp8_mod.E4M3)
+    else:
+        k_t = k_t.astype(cfg.pool_dtype)
+        v_t = v_t.astype(cfg.pool_dtype)
+    k_pool = state.k_pool.at[layer, :, pages, slots].set(k_t)
+    v_pool = state.v_pool.at[layer, :, pages, slots].set(v_t)
+    return CacheState(k_pool, v_pool, k_scale, v_scale)

@@ -1,0 +1,337 @@
+"""The serve engine: jitted prefill/decode steps with a donated cache,
+driven by the continuous-batching scheduler.
+
+Shape discipline — the engine compiles exactly TWO programs and reuses
+them for the whole serving lifetime (replay after a preemption goes
+through the same decode program; that reuse IS the bit-exactness
+argument below):
+
+- the **prefill step** runs one sequence at the static padded prompt
+  length (``max_prompt_len``);
+- the **decode step** runs the full fixed-capacity batch
+  (``max_batch`` slots, inactive slots masked to the null page).
+
+Fixed shapes are not just a compile-cache nicety: because no operation
+in the forward mixes batch rows, a slot's row is a function of that
+slot's inputs alone, independent of batch company — so replaying a
+preempted sequence's generated tokens through the SAME decode program
+reproduces its cache and logits BIT-exactly (asserted in
+``tests/test_serve.py``). The cache pytree is donated through both
+steps: the pool updates in place, never 2x resident.
+
+Tensor parallelism: with a model-parallel mesh installed
+(``parallel_state.initialize_model_parallel(tp)``), both steps wrap in
+``shard_map`` with layouts from :mod:`apex_tpu.serve.rules` — the FULL
+(tp=1-layout) param tree and cache are split by the in_specs, the TP
+layers run their training collectives, and logits/next-token outputs
+come back replicated. The host-side scheduler is unchanged.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu._compat import shard_map
+from apex_tpu.models.gpt import GPTConfig
+from apex_tpu.serve import cache as cache_mod
+from apex_tpu.serve import model as model_mod
+from apex_tpu.serve import rules as rules_mod
+from apex_tpu.serve.scheduler import RUNNING, Scheduler, Sequence
+from apex_tpu.transformer import parallel_state as ps
+
+
+def _default_impls():
+    on_tpu = jax.default_backend() == "tpu"
+    return (("kernel" if on_tpu else "reference"),
+            ("flash" if on_tpu else "reference"))
+
+
+class ServeEngine:
+    """Paged-KV-cache GPT serving on one host (optionally TP-sharded).
+
+    ``params`` is the full (tp=1 layout) ``models.gpt.GPT`` parameter
+    tree (``variables["params"]``). Sampling is greedy argmax —
+    deterministic by design, which the preempt/resume bit-exactness
+    contract relies on.
+    """
+
+    def __init__(self, cfg: GPTConfig, params, *, num_pages: int,
+                 max_seq_len: int, max_prompt_len: int,
+                 page_size: Optional[int] = None, max_batch: int = 4,
+                 fp8_kv: bool = False, fp8_margin: float = 2.0,
+                 paged_impl: Optional[str] = None,
+                 attention_impl: Optional[str] = None,
+                 autotune: Optional[str] = None,
+                 record_logits: bool = False,
+                 interpret: Optional[bool] = None):
+        d_impl, p_impl = _default_impls()
+        self.cfg = cfg
+        self.params = params
+        self.paged_impl = paged_impl or d_impl
+        self.attention_impl = attention_impl or p_impl
+        self.interpret = interpret
+        self.tp = ps.get_tensor_model_parallel_world_size()
+        if cfg.num_heads % self.tp:
+            raise ValueError(f"num_heads {cfg.num_heads} not divisible "
+                             f"by tp {self.tp}")
+        head_dim = cfg.hidden_size // cfg.num_heads
+        # the pool is allocated at GLOBAL head count — under tp the
+        # shard_map in_specs split the heads dim, each rank holding its
+        # local heads' pages; page-size resolution sees the PER-RANK
+        # kernel geometry
+        psize = cache_mod.resolve_page_size(
+            kv_heads=cfg.num_heads // self.tp, head_dim=head_dim,
+            context_len=max_seq_len, dtype=cfg.dtype, fp8=fp8_kv,
+            batch=max_batch, page_size=page_size, autotune=autotune)
+        if max_seq_len > cfg.max_seq_len:
+            raise ValueError(f"max_seq_len {max_seq_len} exceeds the "
+                             f"model's {cfg.max_seq_len}")
+        if max_prompt_len > max_seq_len:
+            raise ValueError("max_prompt_len exceeds max_seq_len")
+        self.max_seq_len = max_seq_len
+        self.max_prompt_len = max_prompt_len
+        self.pages_per_seq = -(-max_seq_len // psize)
+        self.ccfg = cache_mod.CacheConfig(
+            num_layers=cfg.num_layers, kv_heads=cfg.num_heads,
+            head_dim=head_dim, num_pages=num_pages, page_size=psize,
+            dtype=cfg.dtype, fp8=fp8_kv, fp8_margin=fp8_margin)
+        self.state = cache_mod.init_cache(self.ccfg)
+        self.sched = Scheduler(num_pages=num_pages, page_size=psize,
+                               max_batch=max_batch)
+        self.max_batch = max_batch
+        self.slots: List[Optional[Sequence]] = [None] * max_batch
+        self.record_logits = record_logits
+        self.logits_log: Dict[int, Dict[int, np.ndarray]] = {}
+        self.decode_step_times: List[float] = []
+        self.tokens_generated = 0
+        self._next_id = 0
+        self.seqs: Dict[int, Sequence] = {}    # every request ever added
+        self._build_steps()
+
+    # -- jitted steps ------------------------------------------------
+
+    def _build_steps(self):
+        cfg, ccfg = self.cfg, self.ccfg
+
+        def decode(params, state, bt, pos, tok, act):
+            logits, state = model_mod.decode_forward(
+                cfg, ccfg, params, state, bt, pos, tok, act,
+                paged_impl=self.paged_impl, interpret=self.interpret)
+            return logits, jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+                state
+
+        def prefill(params, state, bt, length, ids):
+            logits, state = model_mod.prefill_forward(
+                cfg, ccfg, params, state, bt, length, ids,
+                attention_impl=self.attention_impl,
+                interpret=self.interpret)
+            return logits, jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+                state
+
+        if self.tp > 1:
+            mesh = ps.get_mesh()
+            from jax.sharding import PartitionSpec as P
+            pspec = rules_mod.match_serve_rules(
+                rules_mod.GPT_PARAM_RULES, self.params, world=self.tp)
+            cspec = rules_mod.match_serve_rules(
+                rules_mod.CACHE_RULES, self.state, world=self.tp)
+            decode = shard_map(
+                decode, mesh=mesh,
+                in_specs=(pspec, cspec, P(), P(), P(), P()),
+                out_specs=(P(), P(), cspec), check_vma=False)
+            prefill = shard_map(
+                prefill, mesh=mesh,
+                in_specs=(pspec, cspec, P(), P(), P()),
+                out_specs=(P(), P(), cspec), check_vma=False)
+        # the cache pytree (arg 1) is donated: the pool mutates in
+        # place across steps, never two copies resident (APX007's
+        # convention for state threaded through a hot loop)
+        self._decode = jax.jit(decode, donate_argnums=(1,))
+        self._prefill = jax.jit(prefill, donate_argnums=(1,))
+
+    # -- request intake ----------------------------------------------
+
+    def add_request(self, prompt: List[int], max_new_tokens: int) -> int:
+        if len(prompt) > self.max_prompt_len:
+            raise ValueError(f"prompt length {len(prompt)} exceeds "
+                             f"max_prompt_len {self.max_prompt_len}")
+        if len(prompt) + max_new_tokens > self.max_seq_len:
+            raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        seq = Sequence(seq_id=self._next_id, prompt=list(prompt),
+                       max_new_tokens=max_new_tokens)
+        self._next_id += 1
+        self.seqs[seq.seq_id] = seq
+        self.sched.add(seq)
+        return seq.seq_id
+
+    # -- host-side step driving --------------------------------------
+
+    def _bt_row(self, seq: Sequence) -> np.ndarray:
+        row = np.zeros((self.pages_per_seq,), np.int32)
+        row[:len(seq.pages)] = seq.pages
+        return row
+
+    def _record(self, seq: Sequence, pos: int, logits_row) -> None:
+        if self.record_logits:
+            self.logits_log.setdefault(seq.seq_id, {})[pos] = \
+                np.asarray(logits_row)
+
+    def _free_slot(self, seq: Sequence) -> None:
+        for i, s in enumerate(self.slots):
+            if s is seq:
+                self.slots[i] = None
+
+    def _sample(self, seq: Sequence, token: int) -> None:
+        seq.tokens.append(int(token))
+        self.tokens_generated += 1
+        if seq.done:
+            self.sched.finish(seq)
+            self._free_slot(seq)
+
+    def _replay_generated(self, seq: Sequence) -> None:
+        """Recompute the cache for a resumed sequence's generated
+        tokens through the decode program (single-slot-active batches):
+        the same compiled rows as the original steps, hence bit-exact.
+        The last token is NOT replayed — it is the next decode's
+        input."""
+        slot = self.slots.index(seq)
+        for j in range(len(seq.prompt), seq.num_tokens - 1):
+            tok = np.zeros((self.max_batch,), np.int32)
+            pos = np.zeros((self.max_batch,), np.int32)
+            act = np.zeros((self.max_batch,), bool)
+            bts = np.zeros((self.max_batch, self.pages_per_seq), np.int32)
+            tok[slot] = seq.tokens[j]
+            pos[slot] = j
+            act[slot] = True
+            bts[slot] = self._bt_row(seq)
+            logits, _, self.state = self._decode(
+                self.params, self.state, jnp.asarray(bts),
+                jnp.asarray(pos), jnp.asarray(tok), jnp.asarray(act))
+            self._record(seq, j + 1, logits[slot])
+            seq.num_cached = j + 1
+
+    def _do_prefill(self, seq: Sequence) -> None:
+        slot = self.slots.index(None)
+        self.slots[slot] = seq
+        seq.slot = slot
+        S = self.max_prompt_len
+        ids = np.zeros((S,), np.int32)
+        ids[:len(seq.prompt)] = seq.prompt
+        logits, next_tok, self.state = self._prefill(
+            self.params, self.state, jnp.asarray(self._bt_row(seq)),
+            jnp.int32(len(seq.prompt)), jnp.asarray(ids))
+        seq.num_cached = len(seq.prompt)
+        self._record(seq, len(seq.prompt), logits)
+        if seq.num_generated == 0:
+            self._sample(seq, next_tok)
+        else:
+            # resumed: the generated tokens already exist; rebuild the
+            # cache deterministically instead of re-sampling
+            self._replay_generated(seq)
+
+    def step(self) -> bool:
+        """One scheduler round: prefills + one batched decode. Returns
+        whether any work remains."""
+        plan = self.sched.schedule()
+        for seq in plan.preempted:
+            self._free_slot(seq)
+        for seq in plan.prefill:
+            self._do_prefill(seq)
+        decodes = [s for s in plan.decode
+                   if not s.done and s.state == RUNNING]
+        if decodes:
+            tok = np.zeros((self.max_batch,), np.int32)
+            pos = np.zeros((self.max_batch,), np.int32)
+            act = np.zeros((self.max_batch,), bool)
+            bts = np.zeros((self.max_batch, self.pages_per_seq), np.int32)
+            for seq in decodes:
+                slot = seq.slot
+                tok[slot] = seq.tokens[-1]
+                pos[slot] = seq.num_tokens - 1
+                act[slot] = True
+                bts[slot] = self._bt_row(seq)
+            t0 = time.perf_counter()
+            logits, next_toks, self.state = self._decode(
+                self.params, self.state, jnp.asarray(bts),
+                jnp.asarray(pos), jnp.asarray(tok), jnp.asarray(act))
+            next_np = np.asarray(next_toks)
+            logits_np = np.asarray(logits) if self.record_logits else None
+            self.decode_step_times.append(time.perf_counter() - t0)
+            for seq in decodes:
+                slot = seq.slot
+                seq.num_cached = seq.num_tokens
+                if logits_np is not None:
+                    self._record(seq, seq.num_tokens, logits_np[slot])
+                self._sample(seq, next_np[slot])
+        return self.sched.has_work
+
+    def preempt(self, seq_id: int) -> None:
+        """Force-preempt a running sequence (tests/benchmarks; the
+        organic path is the scheduler's evict-on-exhaustion)."""
+        for seq in self.sched.running:
+            if seq.seq_id == seq_id:
+                self.sched._preempt(seq)
+                self._free_slot(seq)
+                return
+        raise KeyError(f"sequence {seq_id} is not running")
+
+    def run(self, max_steps: int = 100_000) -> Dict[int, List[int]]:
+        """Drive until every request finished; returns seq_id ->
+        generated tokens for EVERY request ever added (including ones
+        that already finished during earlier manual ``step()`` calls)."""
+        steps = 0
+        while self.sched.has_work:
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError("serve engine did not drain "
+                                   f"in {max_steps} steps")
+        return {sid: s.tokens[len(s.prompt):]
+                for sid, s in self.seqs.items()}
+
+
+def naive_generate(cfg: GPTConfig, params, requests, *, max_seq_len: int,
+                   attention_impl: Optional[str] = None):
+    """The full-recompute baseline: same batched greedy decoding, NO
+    KV cache — every token recomputes the whole prefix (one fixed-shape
+    forward over the padded context per step). The bench's honesty
+    anchor for the paged-cache speedup.
+
+    ``requests``: list of ``(prompt, max_new_tokens)``. Returns
+    ``(outputs: list[list[int]], step_times: list[float])``.
+    """
+    _, p_impl = _default_impls()
+    impl = attention_impl or p_impl
+    B = len(requests)
+    S = max_seq_len
+
+    @jax.jit
+    def step(ids, lengths):
+        logits = model_mod.full_forward_logits(cfg, params, ids, lengths,
+                                               attention_impl=impl)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    ids = np.zeros((B, S), np.int32)
+    lengths = np.zeros((B,), np.int32)
+    todo = np.zeros((B,), np.int32)
+    for i, (prompt, n_new) in enumerate(requests):
+        ids[i, :len(prompt)] = prompt
+        lengths[i] = len(prompt)
+        todo[i] = n_new
+    outputs: List[List[int]] = [[] for _ in range(B)]
+    step_times: List[float] = []
+    while (np.array([len(o) for o in outputs]) < todo).any():
+        t0 = time.perf_counter()
+        next_toks = np.asarray(step(jnp.asarray(ids), jnp.asarray(lengths)))
+        step_times.append(time.perf_counter() - t0)
+        for i in range(B):
+            if len(outputs[i]) < todo[i]:
+                outputs[i].append(int(next_toks[i]))
+                ids[i, lengths[i]] = next_toks[i]
+                lengths[i] += 1
+    return outputs, step_times

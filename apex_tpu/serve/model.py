@@ -1,0 +1,260 @@
+"""Serve-side GPT forward passes over the paged KV cache.
+
+Pure functions over the SAME parameter tree ``models.gpt.GPT`` trains
+(``wte/wpe/block_i/{ln1, attn/{qkv,proj}, ln2, mlp/{fc1,fc2}}/ln_f``),
+applied through the SAME tensor-parallel layer modules
+(``Column/RowParallelLinear``, ``VocabParallelEmbedding``,
+``FusedLayerNorm``) — so a checkpoint trained anywhere on the stack
+serves unmodified, and under ``shard_map`` over the tensor axis the
+serve path pays exactly the training collectives (row-parallel psum,
+logits gather). The only new math is the cache interaction:
+
+- :func:`prefill_forward` runs one (padded) prompt through full causal
+  attention and scatters every position's K/V into the sequence's
+  pages;
+- :func:`decode_forward` runs ONE token per batch slot, scatters its
+  K/V, and attends over the cache through the block table (the
+  paged-attention path of ``ops.flash_attention``).
+
+Both are jit-pure: the engine compiles them once per static shape with
+the cache donated. ``monitor.profile`` scopes (``serve_prefill`` /
+``serve_decode`` + the per-module tags inside the TP layers) thread the
+per-request cost attribution through the existing analytic walk.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.gpt import GPTConfig
+from apex_tpu.monitor import profile as _prof
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.ops.flash_attention import (
+    flash_attention, mha_reference, paged_attention_reference,
+    paged_decode_attention)
+from apex_tpu.serve import cache as cache_mod
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    mappings as tp_mappings)
+
+PAGED_IMPLS = ("reference", "kernel")
+PREFILL_IMPLS = ("reference", "flash")
+
+
+def _mods(cfg: GPTConfig):
+    h = cfg.hidden_size
+    return dict(
+        wte=VocabParallelEmbedding(num_embeddings=cfg.vocab_size,
+                                   embedding_dim=h),
+        ln=FusedLayerNorm(normalized_shape=h, dtype=cfg.dtype),
+        qkv=ColumnParallelLinear(input_size=h, output_size=3 * h,
+                                 gather_output=False),
+        proj=RowParallelLinear(input_size=h, output_size=h,
+                               input_is_parallel=True),
+        fc1=ColumnParallelLinear(input_size=h, output_size=cfg.ffn,
+                                 gather_output=False),
+        fc2=RowParallelLinear(input_size=cfg.ffn, output_size=h,
+                              input_is_parallel=True),
+    )
+
+
+def _apply(mod, sub, x):
+    return mod.apply({"params": sub}, x)
+
+
+def _split_qkv(cfg: GPTConfig, qkv):
+    """[..., 3h/tp] -> q, k, v [..., heads_per, d] (the GPT packing:
+    per-head [q|k|v] groups, so the tp column shard is a head split)."""
+    tp = ps.get_tensor_model_parallel_world_size()
+    heads_per = cfg.num_heads // tp
+    d = cfg.hidden_size // cfg.num_heads
+    qkv = qkv.reshape(qkv.shape[:-1] + (heads_per, 3 * d))
+    return jnp.split(qkv, 3, axis=-1)
+
+
+def _logits(cfg: GPTConfig, mods, params, x):
+    """Vocab-parallel LM head + full-vocab gather (serve samples on the
+    host; decode needs the whole row for argmax/top-k)."""
+    with _prof.scope("lm_head"):
+        emb = params["wte"]
+        wte = mods["wte"]
+        logits = wte.apply({"params": emb}, x, method=wte.attend)
+        if ps.get_tensor_model_parallel_world_size() > 1:
+            logits = tp_mappings.gather_from_tensor_model_parallel_region(
+                logits, ps.TENSOR_AXIS, -1)
+        return logits.astype(jnp.float32)
+
+
+def _mlp(cfg: GPTConfig, mods, blk, x):
+    y = _apply(mods["fc1"], blk["mlp"]["fc1"], x)
+    y = jax.nn.gelu(y.astype(jnp.float32), approximate=True).astype(x.dtype)
+    return _apply(mods["fc2"], blk["mlp"]["fc2"], y)
+
+
+def _block_forward(cfg: GPTConfig, mods, blk, x, attend):
+    """One transformer block — the ONE copy of the serve-side block
+    structure (shared by decode, prefill and the no-cache baseline).
+    ``attend(q, k, v)`` owns the per-variant cache interaction and
+    returns the context in ``x``'s leading shape + ``[..., local_h]``.
+    """
+    h1 = _apply(mods["ln"], blk["ln1"], x)
+    q, k, v = _split_qkv(cfg, _apply(mods["qkv"], blk["attn"]["qkv"], h1))
+    ctx = attend(q, k, v)
+    x = x + _apply(mods["proj"], blk["attn"]["proj"],
+                   ctx.astype(cfg.dtype))
+    h2 = _apply(mods["ln"], blk["ln2"], x)
+    return x + _mlp(cfg, mods, blk, h2)
+
+
+def decode_forward(cfg: GPTConfig, ccfg: cache_mod.CacheConfig, params,
+                   state: cache_mod.CacheState, block_tables, positions,
+                   tokens, active, *, paged_impl: str = "reference",
+                   interpret: Optional[bool] = None):
+    """One decode step over a fixed-capacity batch.
+
+    ``tokens``/``positions``/``active``: [B] (the token being fed, its
+    position = index in the sequence, and whether the slot is live —
+    inactive slots carry token 0, position 0 and write to the null
+    page). ``block_tables``: [B, m] int32. Returns ``(logits [B, V]
+    f32, new_state)`` — rows of inactive slots are garbage by contract.
+    Every slot's row depends only on its own inputs (no cross-row
+    reduction anywhere), which is what makes decode-replay after a
+    preemption bit-exact regardless of batch company.
+    """
+    if paged_impl not in PAGED_IMPLS:
+        raise ValueError(f"paged_impl must be one of {PAGED_IMPLS}, got "
+                         f"{paged_impl!r}")
+    mods = _mods(cfg)
+    B = tokens.shape[0]
+    with _prof.scope("serve_decode"):
+        x = _apply(mods["wte"], params["wte"], tokens)
+        x = (x + jnp.take(params["wpe"], positions, axis=0)).astype(cfg.dtype)
+        seq_lens = jnp.where(active, positions + 1, 0).astype(jnp.int32)
+        page_ids = jnp.where(
+            active,
+            block_tables[jnp.arange(B), positions // ccfg.page_size],
+            0).astype(jnp.int32)
+        slots = jnp.where(active, positions % ccfg.page_size,
+                          0).astype(jnp.int32)
+        # state is threaded through the attend closure: python-level
+        # mutation is safe here because the layer loop is sequential
+        # trace-time code
+        state_box = [state]
+        for i in range(cfg.num_layers):
+            def attend(q, k, v, *, _i=i):
+                state_box[0] = cache_mod.write_token(
+                    ccfg, state_box[0], _i, page_ids, slots, k, v)
+                st = state_box[0]
+                with _prof.scope("paged_attn"):
+                    q4 = q[:, :, None, :]            # [B, hp, group=1, d]
+                    scales = {}
+                    if ccfg.fp8:
+                        scales = dict(k_scales=st.k_scale[_i],
+                                      v_scales=st.v_scale[_i])
+                    if paged_impl == "kernel":
+                        ctx = paged_decode_attention(
+                            q4, st.k_pool[_i], st.v_pool[_i],
+                            block_tables, seq_lens, interpret=interpret,
+                            **scales)
+                    else:
+                        ctx = paged_attention_reference(
+                            q4, st.k_pool[_i], st.v_pool[_i],
+                            block_tables, seq_lens, **scales)
+                return ctx[:, :, 0, :].reshape(B, -1)
+
+            with _prof.scope(f"block_{i}"):
+                x = _block_forward(cfg, mods, params[f"block_{i}"], x,
+                                   attend)
+        x = _apply(mods["ln"], params["ln_f"], x)
+        return _logits(cfg, mods, params, x), state_box[0]
+
+
+def prefill_forward(cfg: GPTConfig, ccfg: cache_mod.CacheConfig, params,
+                    state: cache_mod.CacheState, block_table, length,
+                    ids, *, attention_impl: str = "reference",
+                    interpret: Optional[bool] = None):
+    """Full-prompt pass for ONE sequence (padded to the engine's static
+    prompt length). ``ids``: [S] int32 (padded with anything past
+    ``length``); ``block_table``: [m] int32 — pages covering positions
+    ``0..length-1`` (padded entries unused). Writes every live
+    position's K/V and returns ``(logits [V] f32 for position
+    length-1, new_state)``.
+    """
+    if attention_impl not in PREFILL_IMPLS:
+        raise ValueError(f"attention_impl must be one of {PREFILL_IMPLS}, "
+                         f"got {attention_impl!r}")
+    mods = _mods(cfg)
+    S = ids.shape[0]
+    d = cfg.hidden_size // cfg.num_heads
+    with _prof.scope("serve_prefill"):
+        x = _apply(mods["wte"], params["wte"], ids[None])
+        x = (x + params["wpe"][None, :S]).astype(cfg.dtype)
+        sid = jnp.where(jnp.arange(S) < length, 0, -1)[None].astype(jnp.int32)
+        state_box = [state]
+        for i in range(cfg.num_layers):
+            def attend(q, k, v, *, _i=i):
+                state_box[0] = cache_mod.write_prompt(
+                    ccfg, state_box[0], _i, block_table, length, k[0],
+                    v[0])
+                ctx = _causal_attend(q, k, v, d, sid, attention_impl,
+                                     interpret, "prefill_attn")
+                return ctx.reshape(1, S, -1)
+
+            with _prof.scope(f"block_{i}"):
+                x = _block_forward(cfg, mods, params[f"block_{i}"], x,
+                                   attend)
+        x = _apply(mods["ln"], params["ln_f"], x)
+        x_last = jnp.take(x[0], length - 1, axis=0)
+        return _logits(cfg, mods, params, x_last), state_box[0]
+
+
+def _causal_attend(q, k, v, d, sid, attention_impl, interpret, scope):
+    """Full causal attention over padded [b, S] token batches with
+    padding segment ids — the shared attention of prefill and the
+    no-cache baseline. Returns [b, S, hp, d]-shaped context."""
+    qh = q.transpose(0, 2, 1, 3)
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
+    with _prof.scope(scope):
+        if attention_impl == "flash":
+            ctx = flash_attention(qh, kh, vh, causal=True, scale=d ** -0.5,
+                                  segment_ids_q=sid, interpret=interpret)
+        else:
+            ctx = mha_reference(qh, kh, vh, causal=True, scale=d ** -0.5,
+                                segment_ids_q=sid)
+    return ctx.transpose(0, 2, 1, 3)
+
+
+def full_forward_logits(cfg: GPTConfig, params, ids, lengths, *,
+                        attention_impl: str = "reference"):
+    """The NO-cache baseline forward: full causal attention over the
+    whole padded context, logits at each row's last live position.
+    ``ids``: [B, S] int32, ``lengths``: [B] int32. One fixed-shape
+    program regardless of how far generation has progressed — this is
+    what "naive full-recompute decode" pays per token, and what the
+    ``serve_decode`` bench section measures the paged cache against.
+    """
+    if attention_impl not in PREFILL_IMPLS:
+        raise ValueError(f"attention_impl must be one of {PREFILL_IMPLS}, "
+                         f"got {attention_impl!r}")
+    mods = _mods(cfg)
+    B, S = ids.shape
+    d = cfg.hidden_size // cfg.num_heads
+    x = _apply(mods["wte"], params["wte"], ids)
+    x = (x + params["wpe"][None, :S]).astype(cfg.dtype)
+    sid = jnp.where(jnp.arange(S)[None, :] < lengths[:, None], 0,
+                    -1).astype(jnp.int32)
+    for i in range(cfg.num_layers):
+        def attend(q, k, v):
+            return _causal_attend(q, k, v, d, sid, attention_impl, None,
+                                  "full_attn").reshape(B, S, -1)
+
+        x = _block_forward(cfg, mods, params[f"block_{i}"], x, attend)
+    x = _apply(mods["ln"], params["ln_f"], x)
+    x_last = jnp.take_along_axis(x, (lengths - 1)[:, None, None],
+                                 axis=1)[:, 0]
+    return _logits(cfg, mods, params, x_last)

@@ -43,7 +43,8 @@ ENV_CACHE_DIR = "APEX_TPU_TUNE_CACHE"
 # config can be indexed by the kernels without a KeyError
 CONFIG_KEYS = {"flash_attention_fwd": frozenset(("block_q", "block_k")),
                "flash_attention_bwd": frozenset(("block_q", "block_k")),
-               "lm_head_ce": frozenset(("block_t", "block_v"))}
+               "lm_head_ce": frozenset(("block_t", "block_v")),
+               "decode_attention": frozenset(("block_kv",))}
 
 
 def _pow2_ceil(x: int) -> int:
@@ -83,6 +84,12 @@ def shape_bucket(kernel: str, shape: dict) -> str:
     if kernel == "lm_head_ce":
         return (f"n{_pow2_ceil(shape['n'])}_v{_pow2_ceil(shape['v'])}"
                 f"_h{shape['h']}")
+    if kernel == "decode_attention":
+        # bucket batch and context (pow2), pin head geometry exactly —
+        # the page-size optimum tracks d/group, not the exact batch
+        bkv = _pow2_ceil(shape.get("b", 1) * shape.get("kv", 1))
+        return (f"bkv{bkv}_s{_pow2_ceil(shape['s'])}_d{shape['d']}"
+                f"_g{shape.get('group', 1)}")
     raise ValueError(f"unknown kernel {kernel!r}")
 
 

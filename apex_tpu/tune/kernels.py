@@ -36,6 +36,14 @@ DEFAULT_SHAPES = {
         dict(n=8192, v=32768, h=1024, dtype="bfloat16"),
         dict(n=16384, v=30522, h=768, dtype="bfloat16"),
     ],
+    # the serve decode shapes: GPT bench heads at chat-scale contexts,
+    # bf16 and fp8-KV pools (the page size is the pool's allocation
+    # granule — serve.cache resolves it from these entries)
+    "decode_attention": [
+        dict(b=16, kv=16, group=1, s=1024, d=64, dtype="bfloat16"),
+        dict(b=16, kv=16, group=1, s=1024, d=64, dtype="bfloat16",
+             fp8=True),
+    ],
 }
 
 
@@ -48,9 +56,14 @@ def parse_shape_spec(kernel: str, spec: str) -> dict:
     """``"b=8,h=16,s=1024,d=64,dtype=bf16,causal=1"`` -> shape dict.
     ``s=`` sets both sq and sk for flash. Unknown keys raise."""
     flash = kernel.startswith("flash_attention")
-    known = ({"b", "h", "s", "sq", "sk", "d", "dtype", "causal", "bias",
-              "dropout", "segments"} if flash
-             else {"n", "v", "h", "dtype", "smoothing"})
+    decode = kernel == "decode_attention"
+    if flash:
+        known = {"b", "h", "s", "sq", "sk", "d", "dtype", "causal", "bias",
+                 "dropout", "segments"}
+    elif decode:
+        known = {"b", "kv", "group", "s", "d", "dtype", "fp8"}
+    else:
+        known = {"n", "v", "h", "dtype", "smoothing"}
     out: dict = {"dtype": "bfloat16"}
     for part in spec.split(","):
         part = part.strip()
@@ -72,13 +85,21 @@ def parse_shape_spec(kernel: str, spec: str) -> dict:
                 raise ValueError(f"unknown dtype {raw!r} (known aliases: "
                                  f"{sorted(_DTYPES)})")
             out[k] = dt
-        elif k in ("causal", "bias", "dropout", "segments", "smoothing"):
+        elif k in ("causal", "bias", "dropout", "segments", "smoothing",
+                   "fp8"):
             out[k] = val.strip() not in ("0", "false", "False", "")
-        elif k == "s":
+        elif k == "s" and flash:
             out["sq"] = out["sk"] = int(val)
         else:
             out[k] = int(val)
-    if flash:
+    if decode:
+        out.setdefault("b", 1)
+        out.setdefault("kv", 1)
+        out.setdefault("group", 1)
+        for req in ("s", "d"):
+            if req not in out:
+                raise ValueError(f"decode_attention shape spec needs {req}")
+    elif flash:
         out.setdefault("b", 1)
         out.setdefault("h", 1)
         for req in ("sq", "sk", "d"):
@@ -104,6 +125,8 @@ def split_shape(kernel: str, spec: dict):
     if kernel.startswith("flash_attention"):
         flags = {k: bool(spec.pop(k, False))
                  for k in ("causal", "bias", "dropout", "segments")}
+    elif kernel == "decode_attention":
+        flags = {"fp8": bool(spec.pop("fp8", False))}
     else:
         flags = {"smoothing": bool(spec.pop("smoothing", False))}
     spec["itemsize"] = _np_dtype(dtype).itemsize
@@ -209,9 +232,53 @@ def build_lm_head_ce(shape: dict, dtype: str, flags: dict, *,
     return build
 
 
+def build_decode_attention(shape: dict, dtype: str, flags: dict, *,
+                           interpret: Optional[bool] = None):
+    """``build(config)``: jitted paged decode step at the candidate
+    page size. Unlike the flash builders the OPERANDS depend on the
+    config — the page size shapes the pool — so each candidate builds
+    its own synthetic pool (disjoint per-sequence pages, full-context
+    sequence lengths: every page live, the steady-state decode load)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    rng = np.random.RandomState(0)
+    b, kv = shape.get("b", 1), shape.get("kv", 1)
+    g, s, d = shape.get("group", 1), shape["s"], shape["d"]
+    dt = _np_dtype(dtype)
+    fp8 = bool(flags.get("fp8"))
+    q = jnp.asarray(rng.randn(b, kv, g, d) * 0.1, dt)
+
+    def build(config):
+        from apex_tpu.ops.flash_attention import paged_decode_attention
+        bs = config["block_kv"]
+        m = -(-s // bs)
+        n_pages = b * m + 1                      # page 0 stays null
+        kp = rng.randn(kv, n_pages, bs, d) * 0.1
+        vp = rng.randn(kv, n_pages, bs, d) * 0.1
+        scales = {}
+        if fp8:
+            from apex_tpu.amp import fp8 as f8
+            kp = jnp.clip(jnp.asarray(kp, jnp.float32), -f8.E4M3_MAX,
+                          f8.E4M3_MAX).astype(f8.E4M3)
+            vp = jnp.clip(jnp.asarray(vp, jnp.float32), -f8.E4M3_MAX,
+                          f8.E4M3_MAX).astype(f8.E4M3)
+            scales = dict(k_scales=jnp.ones((kv, n_pages), jnp.float32),
+                          v_scales=jnp.ones((kv, n_pages), jnp.float32))
+        else:
+            kp, vp = jnp.asarray(kp, dt), jnp.asarray(vp, dt)
+        bt = jnp.asarray(1 + np.arange(b * m).reshape(b, m), jnp.int32)
+        sl = jnp.full((b,), s, jnp.int32)
+        fn = jax.jit(lambda q, kp, vp, bt, sl: paged_decode_attention(
+            q, kp, vp, bt, sl, interpret=interpret, **scales))
+        return lambda: jax.block_until_ready(fn(q, kp, vp, bt, sl))
+    return build
+
+
 _BUILDERS = {"flash_attention_fwd": build_flash_fwd,
              "flash_attention_bwd": build_flash_bwd,
-             "lm_head_ce": build_lm_head_ce}
+             "lm_head_ce": build_lm_head_ce,
+             "decode_attention": build_decode_attention}
 
 
 def tune_one(kernel: str, shape: dict, dtype: str, flags: dict, *,

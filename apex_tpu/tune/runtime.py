@@ -116,6 +116,10 @@ def _config_sane(kernel: str, cfg: dict, shape: dict, flags: dict) -> bool:
             return vmem.fits(kernel, block_t=cfg["block_t"],
                              block_v=cfg["block_v"], h=shape["h"],
                              itemsize=itemsize)
+        if kernel == "decode_attention":
+            return vmem.fits(kernel, block_kv=cfg["block_kv"],
+                             d=shape["d"], group=shape.get("group", 1),
+                             itemsize=itemsize)
         return False
     except Exception:
         return False

@@ -19,6 +19,11 @@ from apex_tpu.tune import vmem
 _FLASH_BLOCKS = (1024, 512, 256, 128)
 _CE_BLOCK_T = (1024, 512, 256, 128)
 _CE_BLOCK_V = (8192, 4096, 2048, 1024, 512, 256, 128)
+# KV-cache page sizes for the serve decode kernel: the page is the
+# kernel's block (one page of one head per program), AND the pool's
+# allocation granule — smaller pages waste less tail capacity per
+# sequence, larger pages cut program count. 8-sublane aligned.
+_DECODE_BLOCKS = (512, 256, 128, 64, 32, 16)
 
 
 def _pow2_ceil(x: int) -> int:
@@ -70,12 +75,31 @@ def lm_head_ce_space(*, n: int, v: int, h: int,
     return out
 
 
+def decode_attention_space(*, s: int, d: int, group: int = 1,
+                           itemsize: int = 2) -> list[dict]:
+    """Legal ``{"block_kv"}`` (KV-cache page size) candidates for the
+    paged decode kernel. ``s`` is the context length the sweep measures
+    at — pages are clipped to it like flash blocks clip to the
+    sequence."""
+    out = []
+    for bkv in _clip_menu(_DECODE_BLOCKS, max(s, _DECODE_BLOCKS[-1])):
+        if vmem.fits("decode_attention", block_kv=bkv, d=d, group=group,
+                     itemsize=itemsize):
+            out.append({"block_kv": bkv})
+    return out
+
+
 def config_space(kernel: str, shape: dict,
                  flags: Optional[dict] = None) -> list[dict]:
     """Dispatch on the cache's kernel naming: ``flash_attention_fwd``,
-    ``flash_attention_bwd``, ``lm_head_ce``. ``shape``/``flags`` use the
-    same field names the cache key is built from."""
+    ``flash_attention_bwd``, ``lm_head_ce``, ``decode_attention``.
+    ``shape``/``flags`` use the same field names the cache key is built
+    from."""
     flags = flags or {}
+    if kernel == "decode_attention":
+        return decode_attention_space(
+            s=shape["s"], d=shape["d"], group=shape.get("group", 1),
+            itemsize=shape.get("itemsize", 2))
     if kernel in ("flash_attention_fwd", "flash_attention_bwd"):
         return flash_attention_space(
             sq=shape["sq"], sk=shape["sk"], d=shape["d"],

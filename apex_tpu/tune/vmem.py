@@ -38,11 +38,13 @@ FLASH_VMEM_BUDGET = 12 * 1024 * 1024
 # whose standalone resident set already exceeds the raised limit.
 LM_HEAD_VMEM_LIMIT = 64 * 1024 * 1024
 
-KERNELS = ("flash_attention_fwd", "flash_attention_bwd", "lm_head_ce")
+KERNELS = ("flash_attention_fwd", "flash_attention_bwd", "lm_head_ce",
+           "decode_attention")
 
 
 def budget_for(kernel: str) -> int:
-    if kernel in ("flash_attention_fwd", "flash_attention_bwd"):
+    if kernel in ("flash_attention_fwd", "flash_attention_bwd",
+                  "decode_attention"):
         return FLASH_VMEM_BUDGET
     if kernel == "lm_head_ce":
         return LM_HEAD_VMEM_LIMIT
@@ -61,7 +63,8 @@ def _flash_common(block_q: int, block_k: int, d: int, itemsize: int) -> int:
 def vmem_estimate(kernel: str, *, block_q: int = 0, block_k: int = 0,
                   d: int = 0, block_t: int = 0, block_v: int = 0,
                   h: int = 0, itemsize: int = 2, bias: bool = False,
-                  dropout: bool = False, segments: bool = False) -> int:
+                  dropout: bool = False, segments: bool = False,
+                  block_kv: int = 0, group: int = 8) -> int:
     """Estimated resident VMEM bytes for one kernel program at the given
     block config. Flash kernels take ``block_q/block_k/d``; ``lm_head_ce``
     takes ``block_t/block_v/h``. ``itemsize`` is the operand dtype's.
@@ -83,6 +86,20 @@ def vmem_estimate(kernel: str, *, block_q: int = 0, block_k: int = 0,
         extra = 2 * block_q * d * itemsize + 2 * block_k * d * 4
         return (n_tiles * tile + extra
                 + _flash_common(block_q, block_k, d, itemsize))
+    if kernel == "decode_attention":
+        # the serve decode kernel: ``block_kv`` is the KV-cache page
+        # size (one page of one head resident per program). Double-
+        # buffered k+v page blocks in the pool dtype (1 B in fp8-KV
+        # mode), the padded-group q/out blocks, the fp32 accumulator
+        # trio, and one fp32 score tile; block-table/seq-len scalars
+        # and the fp8 page scales ride SMEM and disappear into the
+        # headroom.
+        g8 = max(8, -(-int(group) // 8) * 8)
+        kv_blocks = 2 * 2 * block_kv * d * itemsize
+        q_out = 2 * g8 * d * itemsize
+        acc = g8 * d * 4 + 2 * g8 * 4
+        tile = g8 * block_kv * 4
+        return kv_blocks + q_out + acc + tile
     if kernel == "lm_head_ce":
         # the _pick_blocks budget math, promoted: fp32 dE accumulator
         # block + fp32 logits tile + double-buffered E/x operand blocks

@@ -54,7 +54,7 @@ echo "== ci: bench streaming-evidence smoke =="
     BENCH_STREAM_PATH=/tmp/ci_bench_smoke_stream.jsonl \
     python "$REPO_DIR/bench.py" --smoke > /tmp/ci_bench_smoke.json ) || fail=1
 
-echo "== ci: overlap + zero-bubble + zero-sharded + fp8 + autotune + profile bench sections in the evidence stream =="
+echo "== ci: overlap + zero-bubble + zero-sharded + fp8 + autotune + profile + serve bench sections in the evidence stream =="
 # the PR-4 overlap sections, the PR-5 pp_zero_bubble section, the
 # PR-6 zero_sharded_step section, the PR-7 fp8_step section, the
 # PR-8 autotune section and the PR-10 profile section must land as
@@ -68,13 +68,14 @@ for line in open(sys.argv[1]):
     if ev.get("kind") == "section":
         seen.add(ev.get("name"))
 missing = {"tp_overlap", "ddp_bucket_overlap", "pp_zero_bubble",
-           "zero_sharded_step", "fp8_step", "autotune", "profile"} - seen
+           "zero_sharded_step", "fp8_step", "autotune", "profile",
+           "serve_decode"} - seen
 if missing:
     print(f"ci: sections missing from bench stream: {sorted(missing)}")
     raise SystemExit(1)
 print("ci: tp_overlap + ddp_bucket_overlap + pp_zero_bubble + "
-      "zero_sharded_step + fp8_step + autotune + profile present in "
-      "bench stream")
+      "zero_sharded_step + fp8_step + autotune + profile + serve_decode "
+      "present in bench stream")
 EOF
 
 echo "== ci: bench-trajectory regression gate (monitor.regress) =="

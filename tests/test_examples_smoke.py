@@ -15,6 +15,22 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_serve_gpt_example_smoke():
+    """examples/serve_gpt.py: the serve quickstart runs end-to-end on
+    CPU, and its paged outputs match the naive full-recompute decode."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "serve_gpt.py"),
+         "--requests", "3", "--max-new-tokens", "8", "--fp8-kv",
+         "--compare-naive"],
+        env=env, capture_output=True, text=True, timeout=280)
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-3000:]
+    assert "serve ok" in proc.stdout, proc.stdout[-2000:]
+    assert "fp8-KV capacity" in proc.stdout, proc.stdout[-2000:]
+
+
 def test_simple_amp_example_converges_at_defaults(tmp_path):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
