@@ -1,16 +1,24 @@
 """apex_tpu.lint — static analysis for TPU/JAX correctness invariants.
 
-Two layers (``docs/lint.md`` has the full catalog):
+Three layers (``docs/lint.md`` has the full catalog):
 
 - AST rules APX001-APX007 over the source tree (import-time jax work,
   unknown collective axis names, PRNG key reuse, fp32 pins in
   bf16-castable ops, side effects under jit, array default args,
   undonated jitted train steps);
-- jaxpr checks over traced programs (structural memory/dtype predicates
-  plus collective-axis consistency for registered entrypoints).
+- jaxpr checks over traced programs: the structural memory/dtype
+  predicates and collective-axis consistency (``jaxpr_checks``) plus
+  the APXJ101-105 semantic analyzers (``semantic``: unreduced shard_map
+  outputs, loop-invariant collectives under scan, unbalanced ppermute
+  rings, donation truth from ``donated_invars``);
+- rules-table validation APXR201-204 (``rules_tables``: dead/shadowed
+  regexes, non-divisible shard dims, zero-vs-serve layout conflicts)
+  against the real param trees of the gated entrypoints.
 
-CLI: ``python -m apex_tpu.lint [paths] [--json] [--jaxpr]``; suppress a
-finding inline with ``# apexlint: disable=APXnnn``.
+CLI: ``python -m apex_tpu.lint [paths] [--json] [--jaxpr]
+[--entrypoint NAME] [--baseline lint_report.json]``; suppress an AST
+finding inline with ``# apexlint: disable=APXnnn``, a jaxpr finding via
+``register_entrypoint(..., disable=..., rationale=...)``.
 
 This package intentionally avoids importing jax at import time: the AST
 layer must be able to lint a tree whose jax is broken — that is its job.

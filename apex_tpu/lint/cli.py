@@ -2,6 +2,20 @@
 
 Exit status: 0 = clean, 1 = findings, 2 = usage/internal error — so the
 lint step slots into CI as-is (``scripts/lint.sh``).
+
+``--jaxpr`` runs the full traced layer: the collective-axis consistency
+check, the APXJ101-105 semantic analyzers
+(:mod:`apex_tpu.lint.semantic`), and — unless ``--entrypoint`` narrows
+the run to specific entrypoints — the APXR201-204 rules-table
+validation (:mod:`apex_tpu.lint.rules_tables`). ``--entrypoint NAME``
+(repeatable) restricts the traced gate to the named entrypoints so
+local iteration on one step does not pay for tracing all of them.
+
+``--baseline REPORT.json`` makes the run differential: findings already
+present in the baseline report (matched on ``(code, path, message)`` —
+line numbers drift, messages carry the specifics) are tolerated, and
+the exit status reflects NEW findings only. This is how
+``scripts/ci.sh`` gates PRs against the committed ``lint_report.json``.
 """
 
 from __future__ import annotations
@@ -19,7 +33,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m apex_tpu.lint",
         description="Static analysis for TPU/JAX correctness invariants "
-                    "(AST rules APX001-APX007 + traced jaxpr checks).")
+                    "(AST rules APX001-APX007, traced jaxpr analyzers "
+                    "APXJ101-APXJ105, rules-table checks APXR201-APXR204).")
     p.add_argument("paths", nargs="*", default=["apex_tpu"],
                    help="files or directories to lint (default: apex_tpu)")
     p.add_argument("--json", action="store_true", dest="as_json",
@@ -27,11 +42,34 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--select", default=None,
                    help="comma-separated rule codes to run (default: all)")
     p.add_argument("--jaxpr", action="store_true",
-                   help="also trace the registered entrypoints and check "
-                        "collective-axis consistency (imports jax)")
+                   help="also trace the registered entrypoints and run the "
+                        "jaxpr-layer checks: collective-axis consistency, "
+                        "the APXJ semantic analyzers, and the rules-table "
+                        "validation (imports jax)")
+    p.add_argument("--entrypoint", action="append", default=None,
+                   metavar="NAME",
+                   help="restrict --jaxpr to the named entrypoint "
+                        "(repeatable; skips the rules-table checks — this "
+                        "is the local-iteration path)")
+    p.add_argument("--baseline", default=None, metavar="REPORT",
+                   help="differential gate: exit nonzero only for findings "
+                        "NOT already present in this --json report")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
     return p
+
+
+def _finding_key(f: dict) -> tuple:
+    return (f.get("code"), f.get("path"), f.get("message"))
+
+
+def _failure_key(name: str, problem) -> tuple:
+    """Baseline key for a jaxpr failure: name AND content — a baselined
+    failure on an entrypoint must not mask a NEW, different failure on
+    the same entrypoint."""
+    if isinstance(problem, (set, list, tuple)):
+        return (name, json.dumps(sorted(problem)))
+    return (name, str(problem))
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -43,6 +81,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.list_rules:
         for code, rule in sorted(RULES.items()):
             print(f"{code}  {rule.name}: {rule.description}")
+        from apex_tpu.lint import rules_tables, semantic
+        for code in semantic.CODES + rules_tables.CODES:
+            print(f"{code}  (jaxpr/rules-table layer: see docs/lint.md)")
         return 0
 
     select = ([c.strip() for c in args.select.split(",") if c.strip()]
@@ -54,12 +95,52 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"apexlint: error: no such path(s): {', '.join(missing)}",
               file=sys.stderr)
         return 2
+    if args.entrypoint and not args.jaxpr:
+        print("apexlint: error: --entrypoint requires --jaxpr",
+              file=sys.stderr)
+        return 2
     findings = lint_paths(args.paths, select=select)
 
-    jaxpr_failures = {}
+    jaxpr_failures: dict = {}
+    entrypoints_analyzed: list = []
+    rules_tables_checked: list = []
     if args.jaxpr:
-        from apex_tpu.lint.jaxpr_checks import run_entrypoint_checks
-        jaxpr_failures = run_entrypoint_checks()
+        from apex_tpu.lint import rules_tables, semantic
+        try:
+            res = semantic.run_entrypoint_analyses(names=args.entrypoint)
+        except KeyError as e:
+            # same contract as a typo'd path: an unknown entrypoint must
+            # not read as a clean gate
+            print(f"apexlint: error: {e.args[0]}", file=sys.stderr)
+            return 2
+        jaxpr_failures = res["axis_failures"]
+        entrypoints_analyzed = res["entrypoints"]
+        sem_findings = res["findings"]
+        if args.entrypoint is None:
+            tab = rules_tables.run_rules_table_checks()
+            sem_findings = sem_findings + tab["findings"]
+            rules_tables_checked = tab["tables"]
+        if select is not None:
+            sem_findings = [f for f in sem_findings if f.code in select]
+        findings = findings + sem_findings
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+
+    new_findings = findings
+    new_jaxpr_failures = jaxpr_failures
+    if args.baseline:
+        try:
+            base = json.loads(Path(args.baseline).read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"apexlint: error: cannot read baseline "
+                  f"{args.baseline}: {e}", file=sys.stderr)
+            return 2
+        known = {_finding_key(f) for f in base.get("findings", [])}
+        known_fail = {_failure_key(k, v) for k, v in
+                      base.get("jaxpr_failures", {}).items()}
+        new_findings = [f for f in findings
+                        if _finding_key(f.to_json()) not in known]
+        new_jaxpr_failures = {k: v for k, v in jaxpr_failures.items()
+                              if _failure_key(k, v) not in known_fail}
 
     if args.as_json:
         payload = {
@@ -67,18 +148,33 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "jaxpr_failures": {k: sorted(v) if isinstance(v, set) else v
                                for k, v in jaxpr_failures.items()},
         }
+        if args.jaxpr:
+            payload["entrypoints_analyzed"] = entrypoints_analyzed
+            payload["rules_tables_checked"] = rules_tables_checked
+        if args.baseline:
+            payload["baseline"] = args.baseline
+            payload["new_findings"] = [f.to_json() for f in new_findings]
+            payload["new_jaxpr_failures"] = {
+                k: sorted(v) if isinstance(v, set) else v
+                for k, v in new_jaxpr_failures.items()}
         json.dump(payload, sys.stdout, indent=2)
         sys.stdout.write("\n")
     else:
         for f in findings:
-            print(f.format())
+            marker = "" if f in new_findings else " [baselined]"
+            print(f.format() + marker)
         for name, bad in sorted(jaxpr_failures.items()):
-            print(f"entrypoint {name}: collective-axis check failed: {bad}")
-        total = len(findings) + len(jaxpr_failures)
-        print(f"apexlint: {total} finding(s)"
-              if total else "apexlint: clean")
+            marker = "" if name in new_jaxpr_failures else " [baselined]"
+            print(f"entrypoint {name}: collective-axis check failed: "
+                  f"{bad}{marker}")
+        total = len(new_findings) + len(new_jaxpr_failures)
+        baselined = (len(findings) - len(new_findings)
+                     + len(jaxpr_failures) - len(new_jaxpr_failures))
+        suffix = f" ({baselined} baselined)" if baselined else ""
+        print(f"apexlint: {total} finding(s){suffix}"
+              if total else f"apexlint: clean{suffix}")
 
-    return 1 if (findings or jaxpr_failures) else 0
+    return 1 if (new_findings or new_jaxpr_failures) else 0
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -165,7 +165,14 @@ def _tp_overlap_layers():
     def loss_and_grad(x):
         def loss(x):
             return jnp.sum(block(x) ** 2)
-        return loss(x), jax.grad(loss)(x)
+        # sequence-parallel layers psum_scatter, so the local loss and
+        # grad are per-rank PARTIALS: psum both over the tensor axis so
+        # the P() out_specs are honest (APXJ101 — this entrypoint used
+        # to return rank 0's partial, the exact bug class it now gates)
+        from apex_tpu.transformer import parallel_state as ps
+        l, g = loss(x), jax.grad(loss)(x)
+        return (jax.lax.psum(l, ps.TENSOR_AXIS),
+                jax.lax.psum(g, ps.TENSOR_AXIS))
 
     fn = shard_map(loss_and_grad, mesh=mesh, in_specs=(P(),),
                    out_specs=(P(), P()), check_vma=False)
@@ -317,7 +324,15 @@ def _zero3_train_step():
         shards = model.shard(params)
         state = opt.init(shards, model.spec)
         sstate = scaler_mod.init_state()
-        return step(shards, state, sstate, x, y)
+        out = step(shards, state, sstate, x, y)
+        # the step's outputs are per-rank SHARDS — returning them under
+        # out_specs=P() would record rank 0's partition only (APXJ101,
+        # the bug class this gate exists for). The gate only needs the
+        # collectives in the jaxpr, so reduce to a cross-rank-invariant
+        # fingerprint instead of gathering the whole state.
+        fp = sum(jnp.sum(leaf.astype(jnp.float32))
+                 for leaf in jax.tree_util.tree_leaves(out))
+        return jax.lax.psum(fp, ps.DATA_AXIS)
 
     inner = shard_map(run, mesh=mesh, in_specs=(P(), P(), P()),
                       out_specs=P(), check_vma=False)
@@ -514,6 +529,58 @@ def _serve_decode_step():
     return fn, (params, state, bt, pos, tok, act), mesh.axis_names
 
 
+def _serve_prefill_step():
+    """The serve prefill step under tp=2 — the OTHER compiled serve
+    program (PR 11 gated only decode): one padded prompt through full
+    causal attention with every position's K/V scattered into the
+    rules-sharded paged cache. Same axis hazards as decode (row-parallel
+    psums, the full-vocab logits gather) plus the prompt-scatter path,
+    which must stay rank-local to each rank's heads shard."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from apex_tpu._compat import shard_map
+    from apex_tpu.models.gpt import GPT, GPTConfig
+    from apex_tpu.serve import cache as cache_mod
+    from apex_tpu.serve import model as serve_model
+    from apex_tpu.serve import rules as serve_rules
+
+    cfg = GPTConfig(vocab_size=32, max_seq_len=32, hidden_size=16,
+                    num_layers=1, num_heads=2, dtype=jnp.float32)
+    # same convention as _serve_decode_step: init the FULL tp=1 tree
+    # before installing the tp=2 mesh; shard_map in_specs split it
+    from apex_tpu.transformer import parallel_state as ps
+    ps.destroy_model_parallel()
+    params = GPT(cfg).init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))["params"]
+    mesh, tp, _ = _mesh_for(tp=2)
+    ccfg = cache_mod.CacheConfig(num_layers=1, kv_heads=2, head_dim=8,
+                                 num_pages=4, page_size=8)
+    state = cache_mod.init_cache(ccfg)
+
+    def prefill(params, state, bt, length, ids):
+        logits, state = serve_model.prefill_forward(
+            cfg, ccfg, params, state, bt, length, ids,
+            attention_impl="reference")
+        return logits, state
+
+    pspec = serve_rules.match_serve_rules(serve_rules.GPT_PARAM_RULES,
+                                          params, world=tp)
+    cspec = serve_rules.match_serve_rules(serve_rules.CACHE_RULES,
+                                          state, world=tp)
+    inner = shard_map(prefill, mesh=mesh,
+                      in_specs=(pspec, cspec, P(), P(), P()),
+                      out_specs=(P(), cspec), check_vma=False)
+    # donate_argnums=() is the APX007 conscious opt-out: traced
+    # abstractly only — the REAL prefill (ServeEngine._build_steps)
+    # donates the cache pytree
+    fn = jax.jit(inner, donate_argnums=())
+    bt = jnp.zeros((2,), jnp.int32)
+    length = jnp.asarray(4, jnp.int32)
+    ids = jnp.zeros((16,), jnp.int32)
+    return fn, (params, state, bt, length, ids), mesh.axis_names
+
+
 def _fused_lm_head_ce():
     """Vocab-parallel fused LM-head CE: the pmax/psum trio over the
     tensor axis, plus the Pallas kernels in interpret mode."""
@@ -553,4 +620,5 @@ register_entrypoint("fp8_train_step", _fp8_train_step)
 register_entrypoint("flash_attention_tuned_step", _flash_attention_tuned_step)
 register_entrypoint("profiled_train_step", _profiled_train_step)
 register_entrypoint("serve_decode_step", _serve_decode_step)
+register_entrypoint("serve_prefill_step", _serve_prefill_step)
 register_entrypoint("fused_lm_head_ce", _fused_lm_head_ce)

@@ -144,17 +144,40 @@ def trace_and_check(fn: Callable, *args,
 # import (APX001 discipline applies to this module too).
 ENTRYPOINTS: dict = {}
 
+# name -> {"disable": frozenset of APXJnnn codes, "rationale": str|None}:
+# the per-entrypoint opt-out path for jaxpr findings (the analog of the
+# inline ``# apexlint: disable=`` comment, which has no source line to
+# sit on for a traced program). A disable without a rationale is
+# rejected — the convention mirrors APX007's conscious-opt-out rule.
+ENTRYPOINT_META: dict = {}
 
-def register_entrypoint(name: str, builder: Callable):
-    """Register a traced entrypoint for the collective-consistency check.
+
+def register_entrypoint(name: str, builder: Callable, *,
+                        disable: Iterable[str] = (),
+                        rationale: Optional[str] = None):
+    """Register a traced entrypoint for the jaxpr-layer checks.
 
     ``builder()`` must return ``(fn, args, allowed_axis_names)`` —
     ``fn(*args)`` is traced with ``jax.make_jaxpr`` (under whatever mesh
     the builder installed) and every collective axis it names must be in
-    ``allowed_axis_names``. Keep the shapes tiny: the trace is abstract
-    but still pays compile-trace cost.
+    ``allowed_axis_names``; the semantic analyzers
+    (``apex_tpu.lint.semantic``) run over the same trace. Keep the
+    shapes tiny: the trace is abstract but still pays compile-trace
+    cost.
+
+    ``disable`` opts this entrypoint out of the named APXJ semantic
+    codes; it REQUIRES ``rationale`` (one sentence saying why the
+    finding is acceptable here — the APX007 explicit-``()`` convention
+    for jaxpr findings).
     """
+    disable = frozenset(disable)
+    if disable and not rationale:
+        raise ValueError(
+            f"entrypoint {name!r} disables {sorted(disable)} without a "
+            "rationale — per-entrypoint opt-outs must say why (the "
+            "APX007 conscious-opt-out convention)")
     ENTRYPOINTS[name] = builder
+    ENTRYPOINT_META[name] = {"disable": disable, "rationale": rationale}
 
 
 def run_entrypoint_checks(names: Optional[Iterable[str]] = None) -> dict:

@@ -1,0 +1,290 @@
+"""apexlint rules-table validation: APXR201-APXR204.
+
+The regex rules tables (``zero/rules.py``'s shard/replicate table,
+``serve/rules.py``'s PartitionSpec table) are first-match-wins, which
+means they can rot silently: a regex that matches nothing keeps reading
+as coverage, an earlier rule can make a later one unreachable, and a
+dim/mesh mismatch only explodes when someone finally instantiates the
+config. These checks run the tables against the REAL trees the gated
+entrypoints use (abstractly, via ``jax.eval_shape`` at a realistic
+geometry — no allocation), so the findings are about the tables as
+shipped, not about toy fixtures:
+
+- **APXR201 dead rule** — a rule whose regex matches no leaf path in
+  any provided tree. Either the param it targeted was renamed (the
+  table silently stopped covering it) or the rule is cruft.
+- **APXR202 shadowed rule** — a rule that matches some path but is
+  never the *first* match: an earlier rule wins everywhere, so this
+  rule is unreachable and its decision is silently ignored.
+- **APXR203 non-divisible shard** — a serve rule that shards a tensor
+  dimension that does not exist or does not divide by the declared mesh
+  size. ``match_serve_rules`` raises at rule time; this reports it as a
+  lint finding *before* anything instantiates the config.
+- **APXR204 zero-vs-serve conflict** — the two tables disagree about
+  the same path: a specific serve rule replicates a leaf the zero table
+  shards (layout drift between training and serve), or composing them
+  (ZeRO x TP, ROADMAP item 5's ``ParallelConfig``) makes the zero
+  decision silently flip — the per-tensor-rank shard falls below
+  ``min_shard_size``, so the structural override replicates what the
+  table says to shard.
+
+A FINAL ``'.*'`` catch-all is exempt from APXR201/202: it is the
+sanctioned no-match error-catcher, not coverage. Findings flow through
+the standard schema with pseudo-paths ``<rules-table:NAME>``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional, Sequence
+
+from apex_tpu.lint.core import Finding
+
+CODES = ("APXR201", "APXR202", "APXR203", "APXR204")
+
+#: the tensor-parallel world the gated serve entrypoints declare — the
+#: mesh size divisibility is validated at (serve_decode_step /
+#: serve_prefill_step run tp=2)
+GATE_SERVE_WORLD = 2
+
+
+def _finding(code: str, table: str, message: str) -> Finding:
+    return Finding(code=code, path=f"<rules-table:{table}>", line=0,
+                   col=0, message=message)
+
+
+def _tree_paths(tree) -> list:
+    """[(slash-joined path, leaf)] — the exact path vocabulary the
+    matchers see."""
+    import jax
+
+    from apex_tpu.zero.rules import leaf_path_names
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [("/".join(leaf_path_names(p)), leaf) for p, leaf in flat]
+
+
+def _is_final_catch_all(rules: Sequence, i: int) -> bool:
+    return i == len(rules) - 1 and rules[i][0] in (".*", r".*")
+
+
+def validate_table(rules: Sequence, trees: Iterable[Any], *,
+                   table_name: str, kind: str,
+                   world: Optional[int] = None) -> list:
+    """APXR201/202 (+203 for serve tables) for one rules table against
+    one or more real trees. ``kind``: ``"zero"`` (shard/replicate
+    decisions) or ``"serve"`` (PartitionSpec decisions; ``world`` is
+    the declared mesh size the shard dims must divide)."""
+    from apex_tpu.zero import rules as zero_rules
+
+    if kind not in ("zero", "serve"):
+        raise ValueError(f"kind must be 'zero' or 'serve', got {kind!r}")
+    rules = tuple(rules)
+    findings: list = []
+
+    parsed = [None] * len(rules)
+    for i, (rx, decision) in enumerate(rules):
+        if kind == "zero":
+            if decision not in (zero_rules.SHARD, zero_rules.REPLICATE):
+                findings.append(_finding(
+                    "APXR203", table_name,
+                    f"rule {i} ({rx!r}, {decision!r}): not a zero "
+                    f"decision ({zero_rules.SHARD!r}/"
+                    f"{zero_rules.REPLICATE!r})"))
+        else:
+            from apex_tpu.serve.rules import _parse_decision
+            try:
+                parsed[i] = _parse_decision(rx, decision)
+            except ValueError as e:
+                findings.append(_finding("APXR203", table_name, str(e)))
+
+    matched = [0] * len(rules)        # paths this rule matches at all
+    first = [0] * len(rules)          # paths this rule first-matches
+    import re as _re
+    for tree in trees:
+        for name, leaf in _tree_paths(tree):
+            hits = [_re.search(rx, name) is not None for rx, _ in rules]
+            idx = hits.index(True) if any(hits) else None
+            for i, hit in enumerate(hits):
+                matched[i] += hit
+            if idx is None:
+                findings.append(_finding(
+                    "APXR201", table_name,
+                    f"no rule matches leaf {name!r}: the matcher raises "
+                    "at config time — add a rule (a final ('.*', ...) "
+                    "catch-all is the sanctioned backstop)"))
+                continue
+            first[idx] += 1
+            if kind == "serve" and parsed[idx] is not None:
+                dim = parsed[idx]
+                shape = getattr(leaf, "shape", None) or ()
+                w = int(world or GATE_SERVE_WORLD)
+                if dim >= len(shape):
+                    findings.append(_finding(
+                        "APXR203", table_name,
+                        f"rule {idx} ({rules[idx][0]!r}) shards dim "
+                        f"{dim} of {name!r} but the leaf only has "
+                        f"{len(shape)} dim(s) (shape {tuple(shape)})"))
+                elif w > 1 and shape[dim] % w:
+                    findings.append(_finding(
+                        "APXR203", table_name,
+                        f"rule {idx} ({rules[idx][0]!r}) shards dim "
+                        f"{dim} of {name!r} (shape {tuple(shape)}) over "
+                        f"the declared mesh size {w}: {shape[dim]} is "
+                        "not divisible — the config explodes at "
+                        "instantiation, not here"))
+
+    for i, (rx, decision) in enumerate(rules):
+        if _is_final_catch_all(rules, i):
+            continue
+        if matched[i] == 0:
+            findings.append(_finding(
+                "APXR201", table_name,
+                f"dead rule {i} ({rx!r}, {decision!r}): matches no leaf "
+                "path in any gated tree — the param it targeted was "
+                "renamed (coverage silently lost) or the rule is cruft"))
+        elif first[i] == 0:
+            findings.append(_finding(
+                "APXR202", table_name,
+                f"shadowed rule {i} ({rx!r}, {decision!r}): every path "
+                "it matches is first-matched by an earlier rule, so its "
+                "decision is unreachable (first-match-wins) — reorder "
+                "or delete it"))
+    return findings
+
+
+def cross_check_zero_serve(zero_table: Sequence, serve_table: Sequence,
+                           tree, *, world: int = GATE_SERVE_WORLD,
+                           min_shard_size: Optional[int] = None,
+                           table_name: str = "zero-vs-serve") -> list:
+    """APXR204: the same param tree through both tables; flag paths
+    where the declared layouts drift or compose into a silent no-op."""
+    import numpy as np
+
+    from apex_tpu.serve.rules import _parse_decision
+    from apex_tpu.zero import rules as zero_rules
+    from apex_tpu.zero.rules import first_match
+
+    if min_shard_size is None:
+        min_shard_size = zero_rules.DEFAULT_MIN_SHARD_SIZE
+    zero_table = tuple(zero_table)
+    serve_table = tuple(serve_table)
+    findings: list = []
+    for name, leaf in _tree_paths(tree):
+        elems = int(np.prod(getattr(leaf, "shape", None) or (1,)))
+        if elems < min_shard_size:
+            continue                      # zero structurally replicates
+        zi = first_match(zero_table, name)
+        si = first_match(serve_table, name)
+        if zi is None or si is None:
+            continue                      # APXR201 covers no-match
+        zero_shards = zero_table[zi][1] == zero_rules.SHARD
+        try:
+            serve_dim = _parse_decision(*serve_table[si])
+        except ValueError:
+            continue                      # APXR203 covers bad decisions
+        if not zero_shards:
+            continue
+        if serve_dim is None and not _is_final_catch_all(serve_table, si):
+            findings.append(_finding(
+                "APXR204", table_name,
+                f"layout drift at {name!r}: zero rule {zi} "
+                f"({zero_table[zi][0]!r}) shards it for training but "
+                f"serve rule {si} ({serve_table[si][0]!r}) explicitly "
+                "replicates it per tensor rank — if serve really wants "
+                f"{elems} elements resident on every rank, say so in "
+                "both tables"))
+        elif serve_dim is not None and (elems // max(world, 1)
+                                        < min_shard_size):
+            findings.append(_finding(
+                "APXR204", table_name,
+                f"composition conflict at {name!r}: zero says shard, "
+                f"serve splits dim {serve_dim} over {world} tensor "
+                f"rank(s), and the per-rank shard "
+                f"({elems // max(world, 1)} elements) falls below "
+                f"min_shard_size={min_shard_size} — composed ZeRO x TP "
+                "would silently replicate what the zero table says to "
+                "shard; lower min_shard_size or mark the path replicate"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# the gate: the shipped tables against the gated entrypoints' real trees
+# ---------------------------------------------------------------------------
+
+#: realistic geometry for the abstract (eval_shape) gate trees — big
+#: enough that zero's min_shard_size override does not replicate away
+#: the interesting leaves, tiny to trace (nothing is allocated)
+_GATE_GPT = dict(vocab_size=1024, max_seq_len=256, hidden_size=256,
+                 num_layers=2, num_heads=4)
+_GATE_CACHE = dict(num_layers=2, kv_heads=4, head_dim=64, num_pages=8,
+                   page_size=128)
+
+
+def gate_trees() -> dict:
+    """The abstract real trees the table gate validates against: the
+    GPT param tree (both rule families read it) and the serve cache
+    state in both fp8 modes (``k_scale``/``v_scale`` only exist in the
+    fp8 tree — a validator that forgot it would call those rules dead).
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt import GPT, GPTConfig
+    from apex_tpu.serve import cache as cache_mod
+    from apex_tpu.transformer import parallel_state as ps
+
+    # destroy_model_parallel clears ALL the parallel-state globals, so
+    # put every one of them back (the run_entrypoint_analyses contract)
+    saved = (ps._MESH, ps._VIRTUAL_PIPELINE_WORLD_SIZE,
+             ps._VIRTUAL_PIPELINE_RANK, ps._PIPELINE_SPLIT_RANK)
+    try:
+        ps.destroy_model_parallel()
+        cfg = GPTConfig(dtype=jnp.float32, **_GATE_GPT)
+        gpt = jax.eval_shape(
+            GPT(cfg).init, jax.random.PRNGKey(0),
+            jnp.zeros((1, 8), jnp.int32))["params"]
+    finally:
+        (ps._MESH, ps._VIRTUAL_PIPELINE_WORLD_SIZE,
+         ps._VIRTUAL_PIPELINE_RANK, ps._PIPELINE_SPLIT_RANK) = saved
+    caches = [
+        jax.eval_shape(functools.partial(
+            cache_mod.init_cache,
+            cache_mod.CacheConfig(fp8=fp8, **_GATE_CACHE)))
+        for fp8 in (False, True)]
+    return {"gpt_params": gpt, "cache_states": caches}
+
+
+def run_rules_table_checks() -> dict:
+    """The full rules-table gate: validate both shipped serve tables and
+    the zero default table against the real gated trees, plus the
+    zero-vs-serve cross-check over the shared GPT tree. Returns
+    ``{"findings": [Finding], "tables": [names checked]}``."""
+    from apex_tpu.serve import rules as serve_rules
+    from apex_tpu.zero import rules as zero_rules
+
+    trees = gate_trees()
+    findings: list = []
+    tables: list = []
+
+    tables.append("serve.GPT_PARAM_RULES")
+    findings += validate_table(
+        serve_rules.GPT_PARAM_RULES, [trees["gpt_params"]],
+        table_name="serve.GPT_PARAM_RULES", kind="serve",
+        world=GATE_SERVE_WORLD)
+    tables.append("serve.CACHE_RULES")
+    findings += validate_table(
+        serve_rules.CACHE_RULES, trees["cache_states"],
+        table_name="serve.CACHE_RULES", kind="serve",
+        world=GATE_SERVE_WORLD)
+    tables.append("zero.DEFAULT_RULES")
+    findings += validate_table(
+        zero_rules.DEFAULT_RULES, [trees["gpt_params"]],
+        table_name="zero.DEFAULT_RULES", kind="zero")
+    tables.append("zero-vs-serve(gpt_params)")
+    findings += cross_check_zero_serve(
+        zero_rules.DEFAULT_RULES, serve_rules.GPT_PARAM_RULES,
+        trees["gpt_params"], world=GATE_SERVE_WORLD,
+        table_name="zero-vs-serve(gpt_params)")
+    return {"findings": findings, "tables": tables}

@@ -33,7 +33,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.transformer import parallel_state as ps
-from apex_tpu.zero.rules import leaf_path_names
+from apex_tpu.zero.rules import first_match, leaf_path_names
 
 REPLICATE = "replicate"
 HEADS = "heads"
@@ -101,22 +101,23 @@ def match_serve_rules(
         name = "/".join(leaf_path_names(path))
         if w <= 1 or leaf is None:
             return P()
-        for rx, dim in parsed:
-            if re.search(rx, name) is not None:
-                if dim is None:
-                    return P()
-                shape = np.shape(leaf)
-                if dim >= len(shape) or shape[dim] % w:
-                    raise ValueError(
-                        f"serve rule {rx!r} shards dim {dim} of "
-                        f"{name!r} (shape {shape}) over {axis_name}="
-                        f"{w}: not divisible")
-                spec = [None] * len(shape)
-                spec[dim] = axis_name
-                return P(*spec)
-        raise ValueError(
-            f"no serve layout rule matched leaf {name!r} — add a rule "
-            f"(('.*', 'replicate') is the safe catch-all)")
+        idx = first_match(rules, name)
+        if idx is None:
+            raise ValueError(
+                f"no serve layout rule matched leaf {name!r} — add a "
+                f"rule (('.*', 'replicate') is the safe catch-all)")
+        rx, dim = parsed[idx]
+        if dim is None:
+            return P()
+        shape = np.shape(leaf)
+        if dim >= len(shape) or shape[dim] % w:
+            raise ValueError(
+                f"serve rule {rx!r} shards dim {dim} of "
+                f"{name!r} (shape {shape}) over {axis_name}="
+                f"{w}: not divisible")
+        spec = [None] * len(shape)
+        spec[dim] = axis_name
+        return P(*spec)
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
     return jax.tree_util.tree_unflatten(

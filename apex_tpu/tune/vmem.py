@@ -41,6 +41,38 @@ LM_HEAD_VMEM_LIMIT = 64 * 1024 * 1024
 KERNELS = ("flash_attention_fwd", "flash_attention_bwd", "lm_head_ce",
            "decode_attention")
 
+# Donation-worthiness threshold for the APXJ105 lint check (and anyone
+# else asking "is this state big enough that an undonated round trip
+# hurts"): one flash-kernel VMEM working set. State smaller than a
+# single kernel's on-chip budget is noise next to activations; state at
+# or past it doubles real HBM when a jitted step threads it undonated
+# (input buffers stay alive while the outputs are written).
+DONATION_BYTES_MIN = FLASH_VMEM_BUDGET
+
+
+def aval_nbytes(aval) -> int:
+    """Byte size of an abstract value (aval / ShapeDtypeStruct / array):
+    the ONE sizing rule the lint donation checks and capacity accounting
+    share. Returns 0 for unshaped/untyped objects rather than raising."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for s in shape:
+        n *= int(s)
+    try:
+        return n * dtype.itemsize
+    except AttributeError:
+        import numpy as np
+        return n * np.dtype(dtype).itemsize
+
+
+def tree_nbytes(tree) -> int:
+    """Total :func:`aval_nbytes` over a pytree's leaves."""
+    import jax
+    return sum(aval_nbytes(leaf) for leaf in jax.tree_util.tree_leaves(tree))
+
 
 def budget_for(kernel: str) -> int:
     if kernel in ("flash_attention_fwd", "flash_attention_bwd",

@@ -49,6 +49,18 @@ def leaf_path_names(path) -> tuple[str, ...]:
                  for p in path)
 
 
+def first_match(rules: Sequence[tuple[str, str]], name: str):
+    """Index of the first rule whose regex matches ``name`` — THE
+    first-match-wins resolution every rules table in the package uses
+    (zero, serve, and the ``lint.rules_tables`` validator that audits
+    them for dead/shadowed entries). Returns None when nothing matches.
+    """
+    for i, (rx, _) in enumerate(rules):
+        if re.search(rx, name) is not None:
+            return i
+    return None
+
+
 def match_zero_rules(
     rules: Sequence[tuple[str, str]] | None,
     params: Any,
@@ -78,12 +90,12 @@ def match_zero_rules(
             return False
         if int(np.prod(np.shape(leaf) or (1,))) < min_shard_size:
             return False
-        for rx, decision in rules:
-            if re.search(rx, name) is not None:
-                return decision == SHARD
-        raise ValueError(
-            f"no zero sharding rule matched param {name!r} — add a rule "
-            f"(a catch-all ('.*', 'shard') is the ZeRO-3 default)")
+        idx = first_match(rules, name)
+        if idx is None:
+            raise ValueError(
+                f"no zero sharding rule matched param {name!r} — add a "
+                f"rule (a catch-all ('.*', 'shard') is the ZeRO-3 default)")
+        return rules[idx][1] == SHARD
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     return jax.tree_util.tree_unflatten(

@@ -7,9 +7,17 @@
 #   CI_SKIP_TESTS=1 scripts/ci.sh # lint + selfcheck only (quick loop)
 #
 # Stages:
-#   1. lint        — scripts/lint.sh (AST rules APX001-APX007 + the
-#                    traced-entrypoint collective-axis checks, which
-#                    include the monitor-instrumented amp step)
+#   1. lint        — scripts/lint.sh (AST rules APX001-APX007; jax-free)
+#   1b. lint semantic — the traced jaxpr layer in one pass: collective-
+#                    axis checks over every registered entrypoint, the
+#                    APXJ101-105 semantic analyzers (unreduced shard_map
+#                    outputs, loop-invariant collectives under scan,
+#                    unbalanced ppermute rings, donation truth), and the
+#                    APXR201-204 rules-table validation — DIFFERENTIAL
+#                    against the committed lint_report.json baseline, so
+#                    new code cannot add findings; the stage also asserts
+#                    the gate actually covered the serve entrypoints and
+#                    both rules tables (the bench-stream-keys pattern)
 #   2. tier-1      — the ROADMAP tier-1 pytest command (CPU, 8 virtual
 #                    devices, not-slow subset, 870 s budget)
 #   3. selfcheck   — python -m apex_tpu.monitor selfcheck: records a
@@ -35,8 +43,32 @@ REPO_DIR="$(pwd)"
 
 fail=0
 
-echo "== ci: lint =="
-LINT_JAXPR=1 bash scripts/lint.sh || fail=1
+echo "== ci: lint (AST layer) =="
+bash scripts/lint.sh || fail=1
+
+echo "== ci: lint semantic (jaxpr analyzers + rules tables, differential vs lint_report.json) =="
+JAX_PLATFORMS=cpu XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+  python -m apex_tpu.lint apex_tpu --jaxpr --json \
+    --baseline lint_report.json > /tmp/ci_lint_semantic.json || fail=1
+# coverage assertion, independent of the exit code (the bench-stream-keys
+# pattern): a gate that silently analyzed nothing must not read green
+python - /tmp/ci_lint_semantic.json <<'EOF' || fail=1
+import json, sys
+d = json.load(open(sys.argv[1]))
+eps = set(d.get("entrypoints_analyzed", []))
+tabs = set(d.get("rules_tables_checked", []))
+missing_eps = {"serve_decode_step", "serve_prefill_step",
+               "zero3_train_step", "fp8_train_step"} - eps
+missing_tabs = {"serve.GPT_PARAM_RULES", "serve.CACHE_RULES",
+                "zero.DEFAULT_RULES"} - tabs
+if missing_eps or missing_tabs:
+    print(f"ci: lint semantic gate lost coverage: entrypoints "
+          f"{sorted(missing_eps)}, tables {sorted(missing_tabs)}")
+    raise SystemExit(1)
+print(f"ci: lint semantic covered {len(eps)} entrypoints + "
+      f"{len(tabs)} rules tables; "
+      f"{len(d.get('new_findings', []))} new finding(s) vs baseline")
+EOF
 
 if [[ "${CI_SKIP_TESTS:-0}" != "1" ]]; then
   echo "== ci: tier-1 tests =="

@@ -5,12 +5,17 @@
 #
 #   scripts/lint.sh [paths...]            # default: apex_tpu
 #   LINT_ARTIFACT=out.json scripts/lint.sh
-#   LINT_JAXPR=1 scripts/lint.sh          # also run the traced-entrypoint
-#                                         # collective-consistency checks
+#   LINT_JAXPR=1 scripts/lint.sh          # also run the traced jaxpr layer
+#                                         # (collective axes + APXJ semantic
+#                                         # analyzers + APXR rules tables)
+#
+# NB the artifact default is /tmp, NOT the repo root: the committed
+# lint_report.json is the differential BASELINE scripts/ci.sh compares
+# against (regenerate it with the command in docs/lint.md).
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-ARTIFACT="${LINT_ARTIFACT:-lint_report.json}"
+ARTIFACT="${LINT_ARTIFACT:-/tmp/apexlint_report.json}"
 PATHS=("${@:-apex_tpu}")
 EXTRA=()
 if [[ "${LINT_JAXPR:-0}" == "1" ]]; then

@@ -146,13 +146,38 @@ def test_package_lints_clean():
 
 
 def test_registered_entrypoints_collective_axes_consistent():
-    """Layer 2: trace the registered entrypoints (amp step, TP layers,
-    pipeline schedule, fused LM-head CE) and assert every collective's
-    axis is a real mesh axis."""
+    """Layer 2, ONE trace pass per entrypoint: every collective's axis
+    must be a real mesh axis AND the APXJ101-105 semantic analyzers
+    (unreduced shard_map outputs, loop-invariant scan collectives,
+    unbalanced rings, donation truth) must report nothing — the
+    zero-findings gate the committed lint_report.json baselines."""
+    from apex_tpu.lint.semantic import run_entrypoint_analyses
+
+    res = run_entrypoint_analyses()
+    assert res["axis_failures"] == {}, res["axis_failures"]
+    assert res["findings"] == [], \
+        [f.format() for f in res["findings"]]
+    # both compiled serve programs sit in the gate (PR 11 had only decode)
+    assert {"serve_decode_step", "serve_prefill_step"} <= set(
+        res["entrypoints"])
+
+
+def test_run_entrypoint_checks_api_still_works():
+    """The narrower axis-only runner stays importable and consistent
+    (docs/lint.md documents it); exercised on one cheap entrypoint."""
     from apex_tpu.lint.jaxpr_checks import run_entrypoint_checks
 
-    failures = run_entrypoint_checks()
-    assert failures == {}, failures
+    assert run_entrypoint_checks(names=["fused_lm_head_ce"]) == {}
+
+
+def test_rules_table_gate_clean():
+    """Layer 3: the shipped zero/serve rules tables validate clean
+    against the real gated trees (dead/shadowed/divisibility/conflict
+    checks all silent)."""
+    from apex_tpu.lint.rules_tables import run_rules_table_checks
+
+    res = run_rules_table_checks()
+    assert res["findings"] == [], [f.format() for f in res["findings"]]
 
 
 def test_entrypoints_actually_trace_collectives():

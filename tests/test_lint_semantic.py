@@ -1,0 +1,620 @@
+"""apex_tpu.lint.semantic + rules_tables: the jaxpr-layer analyzers.
+
+Every APXJ detector gets the fire/pass pair the AST rules have: a tiny
+traced program that exhibits the bug class and one that does not. The
+seeded-regression tests then prove the CI gate shape end to end: a
+temporarily registered entrypoint carrying the PR-4 ``out_specs=P()``
+bug (or a dropped donation) must fail the differential gate against the
+committed baseline, and a seeded shadowed/dead rules-table regex must
+surface as an APXR finding.
+"""
+
+import functools
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu._compat import shard_map
+from apex_tpu.lint import rules_tables, semantic
+from apex_tpu.lint.cli import main as cli_main
+from apex_tpu.lint.jaxpr_checks import (ENTRYPOINT_META, ENTRYPOINTS,
+                                        register_entrypoint)
+
+FIXTURES = Path(__file__).parent / "fixtures" / "lint"
+
+
+def _mesh():
+    devs = np.array(jax.devices())
+    return Mesh(devs.reshape(4, 2), ("data", "tensor"))
+
+
+# ---------------------------------------------------------------------------
+# APXJ101 — unreduced shard_map output
+# ---------------------------------------------------------------------------
+
+def test_apxj101_fires_on_unreduced_output():
+    mesh = _mesh()
+
+    def partial_sum(a):
+        return jnp.sum(a)              # per-rank partial under P()
+
+    fn = shard_map(partial_sum, mesh=mesh, in_specs=(P("data"),),
+                   out_specs=P(), check_vma=False)
+    closed = jax.make_jaxpr(fn)(jnp.ones((8,)))
+    findings = semantic.check_unreduced_outputs(closed)
+    assert [f.code for f in findings] == ["APXJ101"]
+    assert "rank 0's shard" in findings[0].message
+
+
+def test_apxj101_passes_when_reduced_or_sharded():
+    mesh = _mesh()
+
+    def reduced(a):
+        return jax.lax.psum(jnp.sum(a), "data")
+
+    fn = shard_map(reduced, mesh=mesh, in_specs=(P("data"),),
+                   out_specs=P(), check_vma=False)
+    assert semantic.check_unreduced_outputs(
+        jax.make_jaxpr(fn)(jnp.ones((8,)))) == []
+
+    def shardy(a):
+        return a * 2.0                 # varies, but the out_spec says so
+
+    fn = shard_map(shardy, mesh=mesh, in_specs=(P("data"),),
+                   out_specs=P("data"), check_vma=False)
+    assert semantic.check_unreduced_outputs(
+        jax.make_jaxpr(fn)(jnp.ones((8,)))) == []
+
+
+def test_apxj101_axis_index_introduces_variance():
+    """A replicated input turned rank-dependent via axis_index leaks."""
+    mesh = _mesh()
+
+    def ranky(a):
+        return a + jax.lax.axis_index("tensor")
+
+    fn = shard_map(ranky, mesh=mesh, in_specs=(P(),), out_specs=P(),
+                   check_vma=False)
+    findings = semantic.check_unreduced_outputs(
+        jax.make_jaxpr(fn)(jnp.ones((4,), jnp.int32)))
+    assert [f.code for f in findings] == ["APXJ101"]
+    assert "tensor" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# APXJ102 — loop-invariant collective under scan
+# ---------------------------------------------------------------------------
+
+def test_apxj102_fires_on_invariant_psum_with_trip_count():
+    mesh = _mesh()
+
+    def run(w, xs):
+        def body(c, x):
+            r = jax.lax.psum(w, "data")        # invariant every trip
+            return c + jnp.sum(x) * jnp.sum(r), None
+        out, _ = jax.lax.scan(body, 0.0, xs)
+        return jax.lax.psum(out, "data")
+
+    fn = shard_map(run, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+                   check_vma=False)
+    closed = jax.make_jaxpr(fn)(jnp.ones((4,)), jnp.ones((6, 5)))
+    findings = semantic.check_scan_collectives(closed)
+    assert [f.code for f in findings] == ["APXJ102"]
+    assert "trip count 6" in findings[0].message   # the profile-walk count
+
+
+def test_apxj102_sees_through_while_and_cond():
+    """A hoistable collective hiding inside a while body (or a cond
+    branch) under the scan must still be found — the generic
+    arity-match descent used to analyze the while COND and stop."""
+    mesh = _mesh()
+
+    def run(w, xs):
+        def body(c, x):
+            def wbody(s):
+                return s + jnp.sum(jax.lax.psum(w, "data"))  # invariant
+
+            s = jax.lax.while_loop(lambda s: s < 3.0, wbody, c)
+            return s + jnp.sum(x), None
+        out, _ = jax.lax.scan(body, 0.0, xs)
+        return jax.lax.psum(out, "data")
+
+    fn = shard_map(run, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+                   check_vma=False)
+    closed = jax.make_jaxpr(fn)(jnp.ones((4,)), jnp.ones((6, 5)))
+    findings = semantic.check_scan_collectives(closed)
+    assert [f.code for f in findings] == ["APXJ102"]
+
+    def run_cond(w, xs):
+        def body(c, x):
+            r = jax.lax.cond(c > 0.0,
+                             lambda: jnp.sum(jax.lax.psum(w, "data")),
+                             lambda: 0.0)
+            return c + r + jnp.sum(x), None
+        out, _ = jax.lax.scan(body, 0.0, xs)
+        return jax.lax.psum(out, "data")
+
+    fn = shard_map(run_cond, mesh=mesh, in_specs=(P(), P()),
+                   out_specs=P(), check_vma=False)
+    closed = jax.make_jaxpr(fn)(jnp.ones((4,)), jnp.ones((6, 5)))
+    findings = semantic.check_scan_collectives(closed)
+    assert [f.code for f in findings] == ["APXJ102"]
+
+
+def test_apxj102_while_variant_carry_not_flagged():
+    """A while carry that STARTS scan-invariant but is poisoned by a
+    variant input on later while iterations must not be flagged — the
+    carry fixpoint, not a single pass."""
+    mesh = _mesh()
+
+    def run(w, xs):
+        def body(c, x):
+            xv = jnp.sum(x)                      # scan-VARIANT
+
+            def wbody(s):
+                # psum(s): invariant on the FIRST while iteration only
+                return jnp.sum(jax.lax.psum(s, "data")) + xv
+
+            s = jax.lax.while_loop(lambda s: s < 3.0, wbody, jnp.sum(w))
+            return c + s, None
+        out, _ = jax.lax.scan(body, 0.0, xs)
+        return jax.lax.psum(out, "data")
+
+    fn = shard_map(run, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+                   check_vma=False)
+    closed = jax.make_jaxpr(fn)(jnp.ones((4,)), jnp.ones((6, 5)))
+    assert semantic.check_scan_collectives(closed) == []
+
+
+def test_apxj102_passes_on_carry_dependent_collective():
+    mesh = _mesh()
+
+    def run(w, xs):
+        def body(c, x):
+            r = jax.lax.psum(c * jnp.sum(w), "data")   # carry-dependent
+            return c + r, None
+        out, _ = jax.lax.scan(body, 0.0, xs)
+        return jax.lax.psum(out, "data")
+
+    fn = shard_map(run, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+                   check_vma=False)
+    assert semantic.check_scan_collectives(
+        jax.make_jaxpr(fn)(jnp.ones((4,)), jnp.ones((6, 5)))) == []
+
+
+# ---------------------------------------------------------------------------
+# APXJ103 — unbalanced ppermute ring
+# ---------------------------------------------------------------------------
+
+def _ring(a, nhops):
+    x, acc = a, a
+    perm = [(i, (i + 1) % 4) for i in range(4)]
+    for _ in range(nhops):
+        x = jax.lax.ppermute(x, "data", perm)
+        acc = acc + x
+    return jax.lax.psum(acc, "data")
+
+
+def test_apxj103_fires_on_dropped_hop():
+    mesh = _mesh()
+    fn = shard_map(functools.partial(_ring, nhops=2), mesh=mesh,
+                   in_specs=(P("data"),), out_specs=P(), check_vma=False)
+    findings = semantic.check_ppermute_rings(
+        jax.make_jaxpr(fn)(jnp.ones((8,))))
+    assert [f.code for f in findings] == ["APXJ103"]
+    assert "size 4" in findings[0].message
+
+
+def test_apxj103_passes_on_full_ring_and_double_ring():
+    mesh = _mesh()
+    for nhops in (3, 6):               # one ring, two rings
+        fn = shard_map(functools.partial(_ring, nhops=nhops), mesh=mesh,
+                       in_specs=(P("data"),), out_specs=P(),
+                       check_vma=False)
+        assert semantic.check_ppermute_rings(
+            jax.make_jaxpr(fn)(jnp.ones((8,)))) == []
+
+
+def test_apxj103_ignores_scan_carried_p2p():
+    """Pipeline-style one-hop-per-tick ppermutes live in scan bodies and
+    are not rings — excluded by construction."""
+    mesh = _mesh()
+
+    def run(xs):
+        perm = [(i, (i + 1) % 4) for i in range(4)]
+
+        def body(c, x):
+            return jax.lax.ppermute(c + x, "data", perm), None
+        out, _ = jax.lax.scan(body, jnp.zeros(()), xs)
+        return jax.lax.psum(out, "data")
+
+    fn = shard_map(run, mesh=mesh, in_specs=(P(),), out_specs=P(),
+                   check_vma=False)
+    assert semantic.check_ppermute_rings(
+        jax.make_jaxpr(fn)(jnp.ones((5,)))) == []
+
+
+# ---------------------------------------------------------------------------
+# APXJ104 / APXJ105 — donation truth
+# ---------------------------------------------------------------------------
+
+def test_apxj104_fires_on_donated_returned_unupdated():
+    def step(params, g):
+        return params, jnp.sum(g)      # donated arg passed straight out
+
+    j = jax.jit(step, donate_argnums=(0,))
+    findings = semantic.check_donation(
+        jax.make_jaxpr(j)(jnp.ones((4, 4)), jnp.ones((4, 4))))
+    assert [f.code for f in findings] == ["APXJ104"]
+
+
+def test_apxj104_fires_on_read_after_aliasing_write():
+    def step(params, g):
+        new = params - g               # the aliasing write
+        aux = jnp.sum(params)          # read AFTER it: forces a copy
+        return new, aux
+
+    j = jax.jit(step, donate_argnums=(0,))
+    findings = semantic.check_donation(
+        jax.make_jaxpr(j)(jnp.ones((4, 4)), jnp.ones((4, 4))))
+    assert [f.code for f in findings] == ["APXJ104"]
+    assert "copy" in findings[0].message
+
+
+def test_apxj104_passes_on_proper_update():
+    def step(params, g):
+        return params - 0.1 * g, jnp.sum(g)
+
+    j = jax.jit(step, donate_argnums=(0,))
+    assert semantic.check_donation(
+        jax.make_jaxpr(j)(jnp.ones((4, 4)), jnp.ones((4, 4)))) == []
+
+
+_BIG = jax.ShapeDtypeStruct((2048, 2048), jnp.float32)   # 16 MiB
+_SMALL = jax.ShapeDtypeStruct((4,), jnp.float32)
+
+
+def test_apxj105_fires_on_large_undonated_round_trip():
+    def step(params, x):
+        return params * 0.9, jnp.sum(x)
+
+    findings = semantic.check_donation(
+        jax.make_jaxpr(jax.jit(step))(_BIG, _SMALL))
+    assert [f.code for f in findings] == ["APXJ105"]
+    assert "DONATION_BYTES_MIN" in findings[0].message
+
+
+def test_apxj105_passes_when_donated_or_small_or_no_round_trip():
+    def step(params, x):
+        return params * 0.9, jnp.sum(x)
+
+    donated = jax.jit(step, donate_argnums=(0,))
+    assert semantic.check_donation(
+        jax.make_jaxpr(donated)(_BIG, _SMALL)) == []
+    assert semantic.check_donation(
+        jax.make_jaxpr(jax.jit(step))(_SMALL, _SMALL)) == []
+
+    def inference(params, x):          # no matching output: batch-like
+        return jnp.sum(params) + jnp.sum(x)
+
+    assert semantic.check_donation(
+        jax.make_jaxpr(jax.jit(inference))(_BIG, _SMALL)) == []
+
+
+# ---------------------------------------------------------------------------
+# per-entrypoint opt-out (the jaxpr analog of the inline disable)
+# ---------------------------------------------------------------------------
+
+def _seeded_undonated_builder():
+    mesh = _mesh()
+
+    def step(params, x):
+        return params * 0.9, jnp.sum(x)
+
+    fn = jax.jit(step)
+    return fn, (_BIG, _SMALL), mesh.axis_names
+
+
+def _seeded_unreduced_builder():
+    mesh = _mesh()
+
+    def partial_sum(a):
+        return jnp.sum(a)
+
+    fn = shard_map(partial_sum, mesh=mesh, in_specs=(P("data"),),
+                   out_specs=P(), check_vma=False)
+    return fn, (jnp.ones((8,)),), mesh.axis_names
+
+
+@pytest.fixture
+def _temp_entrypoint():
+    """Register-and-clean-up helper for seeded-regression tests."""
+    added = []
+
+    def add(name, builder, **kw):
+        register_entrypoint(name, builder, **kw)
+        added.append(name)
+        return name
+
+    yield add
+    for name in added:
+        ENTRYPOINTS.pop(name, None)
+        ENTRYPOINT_META.pop(name, None)
+
+
+def test_entrypoint_disable_requires_rationale():
+    with pytest.raises(ValueError, match="rationale"):
+        register_entrypoint("_no_rationale", _seeded_undonated_builder,
+                            disable=("APXJ105",))
+    assert "_no_rationale" not in ENTRYPOINTS
+
+
+def test_entrypoint_disable_filters_jaxpr_findings(_temp_entrypoint):
+    name = _temp_entrypoint("_tmp_apxj105", _seeded_undonated_builder)
+    res = semantic.run_entrypoint_analyses(names=[name])
+    assert [f.code for f in res["findings"]] == ["APXJ105"]
+
+    ENTRYPOINTS.pop(name)
+    ENTRYPOINT_META.pop(name)
+    _temp_entrypoint(
+        name, _seeded_undonated_builder, disable=("APXJ105",),
+        rationale="test fixture: the caller reuses the input buffers")
+    res = semantic.run_entrypoint_analyses(names=[name])
+    assert res["findings"] == []
+    # the opt-out is per-code, not blanket: a different finding on the
+    # same entrypoint still surfaces
+    assert ENTRYPOINT_META[name]["disable"] == frozenset({"APXJ105"})
+    assert "reuses" in ENTRYPOINT_META[name]["rationale"]
+
+
+# ---------------------------------------------------------------------------
+# seeded regressions through the CI gate shape
+# ---------------------------------------------------------------------------
+
+def test_seeded_unreduced_output_fails_differential_gate(
+        _temp_entrypoint, capsys):
+    """The PR-4 bug class, seeded as a registered entrypoint, must fail
+    the exact CLI invocation scripts/ci.sh runs (differential against
+    the committed baseline)."""
+    name = _temp_entrypoint("_tmp_unreduced", _seeded_unreduced_builder)
+    baseline = Path(__file__).parent.parent / "lint_report.json"
+    rc = cli_main([str(FIXTURES / "apx001_clean.py"), "--jaxpr",
+                   "--entrypoint", name, "--json",
+                   "--baseline", str(baseline)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [f["code"] for f in payload["new_findings"]] == ["APXJ101"]
+    assert payload["entrypoints_analyzed"] == [name]
+
+
+def test_seeded_dropped_donation_fails_differential_gate(
+        _temp_entrypoint, capsys):
+    name = _temp_entrypoint("_tmp_dropped_donation",
+                            _seeded_undonated_builder)
+    baseline = Path(__file__).parent.parent / "lint_report.json"
+    rc = cli_main([str(FIXTURES / "apx001_clean.py"), "--jaxpr",
+                   "--entrypoint", name, "--json",
+                   "--baseline", str(baseline)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [f["code"] for f in payload["new_findings"]] == ["APXJ105"]
+
+
+def test_baselined_finding_does_not_fail_gate(_temp_entrypoint, capsys,
+                                              tmp_path):
+    """A finding recorded in the baseline is tolerated (exit 0) but a
+    SECOND new finding still fails: the differential contract."""
+    name = _temp_entrypoint("_tmp_baselined", _seeded_unreduced_builder)
+    args = [str(FIXTURES / "apx001_clean.py"), "--jaxpr",
+            "--entrypoint", name, "--json"]
+    rc = cli_main(args)
+    payload = capsys.readouterr().out
+    assert rc == 1
+    base = tmp_path / "base.json"
+    base.write_text(payload)
+    rc = cli_main(args + ["--baseline", str(base)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["new_findings"] == []
+    assert [f["code"] for f in payload["findings"]] == ["APXJ101"]
+
+
+def _seeded_bad_axis_builder():
+    """Collective over an axis the allowed set does not contain — an
+    axis-consistency failure, not a semantic finding."""
+    mesh = _mesh()
+
+    def f(a):
+        return jax.lax.psum(a, "data")
+
+    fn = shard_map(f, mesh=mesh, in_specs=(P("data"),),
+                   out_specs=P("data"), check_vma=False)
+    return fn, (jnp.ones((8,)),), ("tensor",)   # 'data' not allowed
+
+
+def test_baselined_jaxpr_failure_keyed_by_content(_temp_entrypoint,
+                                                  capsys, tmp_path):
+    """A baselined axis failure must not mask a DIFFERENT failure on
+    the same entrypoint: the key is (name, content), not name."""
+    name = _temp_entrypoint("_tmp_bad_axis", _seeded_bad_axis_builder)
+    args = [str(FIXTURES / "apx001_clean.py"), "--jaxpr",
+            "--entrypoint", name, "--json"]
+    rc = cli_main(args)
+    out = capsys.readouterr().out
+    assert rc == 1
+    # same failure baselined -> tolerated
+    base = tmp_path / "base.json"
+    base.write_text(out)
+    rc = cli_main(args + ["--baseline", str(base)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["new_jaxpr_failures"] == {}
+    # baseline recording a DIFFERENT problem for the same name -> fails
+    stale = json.loads(out)
+    stale["jaxpr_failures"][name] = ["some_other_axis"]
+    base.write_text(json.dumps(stale))
+    rc = cli_main(args + ["--baseline", str(base)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert name in payload["new_jaxpr_failures"]
+
+
+# ---------------------------------------------------------------------------
+# rules-table validation
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def _gate_trees():
+    return rules_tables.gate_trees()
+
+
+def test_rules_tables_real_gate_is_clean():
+    res = rules_tables.run_rules_table_checks()
+    assert res["findings"] == [], \
+        [f.format() for f in res["findings"]]
+    assert set(res["tables"]) >= {"serve.GPT_PARAM_RULES",
+                                  "serve.CACHE_RULES",
+                                  "zero.DEFAULT_RULES"}
+
+
+def test_dead_rule_detected(_gate_trees):
+    from apex_tpu.serve.rules import GPT_PARAM_RULES
+    seeded = (("attn/qkv_packed/kernel", "shard:1"),) + tuple(
+        GPT_PARAM_RULES)
+    findings = rules_tables.validate_table(
+        seeded, [_gate_trees["gpt_params"]], table_name="seeded",
+        kind="serve", world=2)
+    assert [f.code for f in findings] == ["APXR201"]
+    assert "qkv_packed" in findings[0].message
+
+
+def test_shadowed_rule_detected(_gate_trees):
+    """The seeded regression from the issue: a zero.rules regex made
+    unreachable by an earlier broader one."""
+    seeded = ((".*", "shard"), ("bias", "replicate"))
+    findings = rules_tables.validate_table(
+        seeded, [_gate_trees["gpt_params"]], table_name="seeded",
+        kind="zero")
+    assert [f.code for f in findings] == ["APXR202"]
+    assert "'bias'" in findings[0].message
+
+
+def test_final_catch_all_exempt_from_dead_and_shadowed(_gate_trees):
+    """CACHE_RULES' final ('.*', replicate) never first-matches (every
+    cache leaf is named) — the sanctioned backstop must not read as
+    shadowed."""
+    from apex_tpu.serve.rules import CACHE_RULES
+    findings = rules_tables.validate_table(
+        CACHE_RULES, _gate_trees["cache_states"], table_name="cache",
+        kind="serve", world=2)
+    assert findings == []
+
+
+def test_scale_rules_need_the_fp8_tree(_gate_trees):
+    """Validating CACHE_RULES against only the bf16 cache calls the
+    k/v_scale rule dead — the gate runs BOTH real trees, which is why."""
+    from apex_tpu.serve.rules import CACHE_RULES
+    bf16_only = [_gate_trees["cache_states"][0]]
+    findings = rules_tables.validate_table(
+        CACHE_RULES, bf16_only, table_name="cache-bf16", kind="serve",
+        world=2)
+    assert [f.code for f in findings] == ["APXR201"]
+    assert "scale" in findings[0].message
+
+
+def test_non_divisible_shard_detected(_gate_trees):
+    from apex_tpu.serve.rules import CACHE_RULES
+    findings = rules_tables.validate_table(
+        CACHE_RULES, _gate_trees["cache_states"], table_name="cache",
+        kind="serve", world=3)
+    assert findings and all(f.code == "APXR203" for f in findings)
+    assert "not divisible" in findings[0].message
+
+
+def test_shard_dim_out_of_range_detected(_gate_trees):
+    seeded = ((r".*", "shard:7"),)
+    findings = rules_tables.validate_table(
+        seeded, [_gate_trees["gpt_params"]], table_name="seeded",
+        kind="serve", world=2)
+    assert findings and all(f.code == "APXR203" for f in findings)
+
+
+def test_zero_vs_serve_layout_drift_detected(_gate_trees):
+    from apex_tpu.serve.rules import GPT_PARAM_RULES
+    from apex_tpu.zero.rules import DEFAULT_RULES
+    seeded = (("attn/qkv/kernel", "replicate"),) + tuple(GPT_PARAM_RULES)
+    findings = rules_tables.cross_check_zero_serve(
+        DEFAULT_RULES, seeded, _gate_trees["gpt_params"], world=2)
+    assert findings and all(f.code == "APXR204" for f in findings)
+    assert "drift" in findings[0].message
+
+
+def test_zero_vs_serve_composition_conflict_detected(_gate_trees):
+    from apex_tpu.serve.rules import GPT_PARAM_RULES
+    from apex_tpu.zero.rules import DEFAULT_RULES
+    findings = rules_tables.cross_check_zero_serve(
+        DEFAULT_RULES, GPT_PARAM_RULES, _gate_trees["gpt_params"],
+        world=2, min_shard_size=60_000)
+    assert findings and all(f.code == "APXR204" for f in findings)
+    assert "min_shard_size" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# CLI integration
+# ---------------------------------------------------------------------------
+
+def test_cli_entrypoint_filter_skips_rules_tables(capsys):
+    rc = cli_main([str(FIXTURES / "apx001_clean.py"), "--jaxpr",
+                   "--entrypoint", "fused_lm_head_ce", "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["entrypoints_analyzed"] == ["fused_lm_head_ce"]
+    assert payload["rules_tables_checked"] == []
+
+
+def test_cli_unknown_entrypoint_is_an_error(capsys):
+    """A typo'd entrypoint must exit 2, not trace nothing and read
+    clean (the missing-path contract, applied to the traced gate)."""
+    rc = cli_main([str(FIXTURES / "apx001_clean.py"), "--jaxpr",
+                   "--entrypoint", "no_such_entrypoint"])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_cli_entrypoint_without_jaxpr_is_an_error(capsys):
+    rc = cli_main([str(FIXTURES / "apx001_clean.py"),
+                   "--entrypoint", "fused_lm_head_ce"])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_cli_select_filters_jaxpr_codes(_temp_entrypoint, capsys):
+    name = _temp_entrypoint("_tmp_select", _seeded_unreduced_builder)
+    rc = cli_main([str(FIXTURES / "apx001_clean.py"), "--jaxpr",
+                   "--entrypoint", name, "--select", "APXJ104", "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["findings"] == []
+    rc = cli_main([str(FIXTURES / "apx001_clean.py"), "--jaxpr",
+                   "--entrypoint", name, "--select", "APXJ101", "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [f["code"] for f in payload["findings"]] == ["APXJ101"]
+
+
+def test_committed_baseline_matches_gate_schema():
+    """lint_report.json is the report the differential gate reads: it
+    must be the --json schema, cover every registered entrypoint and
+    all rules tables, and carry zero findings (the acceptance bar)."""
+    from apex_tpu.lint import entrypoints  # noqa: F401 (registers)
+
+    base = json.loads(
+        (Path(__file__).parent.parent / "lint_report.json").read_text())
+    assert base["findings"] == []
+    assert base["jaxpr_failures"] == {}
+    assert set(base["entrypoints_analyzed"]) == set(ENTRYPOINTS)
+    assert set(base["rules_tables_checked"]) >= {
+        "serve.GPT_PARAM_RULES", "serve.CACHE_RULES", "zero.DEFAULT_RULES"}
