@@ -5,12 +5,15 @@ Reference: ``apex/contrib/layer_norm/layer_norm.py`` — ``FastLayerNorm``
 (``ln_fwd``/``ln_bwd``, ``apex/contrib/csrc/layer_norm/``), apex's
 second, faster LN for large hidden sizes.
 
-TPU disposition (measured, r2): a second LN implementation buys nothing
-here — the custom-VJP LayerNorm in ``apex_tpu.ops.layer_norm`` already
-matches a hand-written Pallas LN standalone and beats it in-model (XLA
-fuses the jnp composition with its neighbors; see docs/perf.md). This
-module therefore re-exports the one implementation under the reference's
-``FastLayerNorm`` module API so ported code imports unchanged.
+TPU disposition: ONE LN implementation serves both of apex's
+(``apex_tpu.ops.layer_norm``), and since ISSUE 13 it is kernel-or-shim
+resolved — a real Pallas fwd+bwd pair engages where a tuned cache entry
+(``python -m apex_tpu.ops tune --kernel fused_layer_norm``) or an
+explicit ``block_r`` says it wins, and the jnp shim (which the r2
+measurement showed XLA fuses with its neighbors) remains the default.
+This module re-exports that one implementation under the reference's
+``FastLayerNorm`` module API so ported code imports unchanged; kernel
+knobs (``block_r=``, ``autotune=``) pass through.
 """
 
 from __future__ import annotations
@@ -27,8 +30,10 @@ def FastLayerNorm(hidden_size, eps: float = 1e-5, **kw) -> FusedLayerNorm:
     return FusedLayerNorm(normalized_shape=hidden_size, eps=eps, **kw)
 
 
-def ln_fwd(x, gamma, beta, epsilon: float = 1e-5):
+def ln_fwd(x, gamma, beta, epsilon: float = 1e-5, **kw):
     """Functional fwd (the ``fast_layer_norm.ln_fwd`` entry): returns the
     normalized output (row stats are autodiff residuals here, not
-    caller-managed)."""
-    return fused_layer_norm_affine(x, gamma, beta, gamma.shape, epsilon)
+    caller-managed). Kernel knobs (``block_r=``, ``autotune=``,
+    ``interpret=``) pass through to the resolved implementation."""
+    return fused_layer_norm_affine(x, gamma, beta, gamma.shape, epsilon,
+                                   **kw)

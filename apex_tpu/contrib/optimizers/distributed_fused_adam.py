@@ -34,11 +34,12 @@ class DistributedFusedAdam(ZeroOptimizer):
                  eps=1e-8, weight_decay=0.0, adam_w_mode=True,
                  gradient_average=True, axis_name: str = "data",
                  compress_allgather: bool = False,
-                 overlap_comm: bool = False):
+                 overlap_comm: bool = False,
+                 autotune: str | None = None):
         super().__init__(
             lr, kind="adam", shard_params=False,
             bias_correction=bias_correction, betas=betas, eps=eps,
             weight_decay=weight_decay, adam_w_mode=adam_w_mode,
             gradient_average=gradient_average, axis_name=axis_name,
             compress_allgather=compress_allgather,
-            overlap_comm=overlap_comm)
+            overlap_comm=overlap_comm, autotune=autotune)

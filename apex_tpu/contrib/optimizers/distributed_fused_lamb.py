@@ -37,14 +37,15 @@ class DistributedFusedLAMB(ZeroOptimizer):
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
                  eps=1e-6, weight_decay=0.01, max_grad_norm=1.0,
                  adam_w_mode=True, grad_averaging=True, use_nvlamb=False,
-                 axis_name: str = "data", overlap_comm: bool = False):
+                 axis_name: str = "data", overlap_comm: bool = False,
+                 autotune: str | None = None):
         super().__init__(
             lr, kind="lamb", shard_params=False,
             bias_correction=bias_correction, betas=betas, eps=eps,
             weight_decay=weight_decay, adam_w_mode=adam_w_mode,
             gradient_average=grad_averaging, max_grad_norm=max_grad_norm,
             use_nvlamb=use_nvlamb, axis_name=axis_name,
-            overlap_comm=overlap_comm)
+            overlap_comm=overlap_comm, autotune=autotune)
 
     @property
     def grad_averaging(self):

@@ -3,9 +3,13 @@
 Reference: ``apex/contrib/xentropy/__init__.py`` exposing
 ``SoftmaxCrossEntropyLoss`` backed by ``xentropy_cuda``
 (``apex/contrib/xentropy/softmax_xentropy.py:4-31``).
+
+DEPRECATED pointer: this is a thin re-export over the ONE fused CE
+implementation in :mod:`apex_tpu.ops.fused_ce` (Pallas kernels + XLA
+reference twin, tuner-resolved); import from there in new code.
 """
 
-from apex_tpu.ops.xentropy import (  # noqa: F401
+from apex_tpu.ops.fused_ce import (  # noqa: F401
     SoftmaxCrossEntropyLoss,
     softmax_cross_entropy_with_smoothing,
 )

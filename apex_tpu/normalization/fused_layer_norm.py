@@ -39,6 +39,11 @@ class FusedLayerNorm(nn.Module):
     # (e.g. bf16) to get bf16 in -> bf16 out with fp32 params and no
     # call-site casts.
     dtype: jnp.dtype | None = None
+    # Pallas-kernel resolution for the affine path (ops/layer_norm.py):
+    # explicit block_r > tuned cache (per `autotune` policy) > jnp shim.
+    # Defaults leave callers bit-for-bit on the pre-kernel program.
+    autotune: str | None = None
+    block_r: int | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -49,7 +54,8 @@ class FusedLayerNorm(nn.Module):
             bias = self.param(
                 "bias", nn.initializers.zeros, shape, self.param_dtype)
             return fused_layer_norm_affine(x, weight, bias, shape, self.eps,
-                                           self.dtype)
+                                           self.dtype, block_r=self.block_r,
+                                           autotune=self.autotune)
         y = fused_layer_norm(x, shape, self.eps)
         return y if self.dtype is None else y.astype(self.dtype)
 
@@ -67,7 +73,7 @@ class MixedFusedLayerNorm(FusedLayerNorm):
         # inherited `dtype` still overrides the output (x.dtype otherwise)
         return fused_layer_norm_affine(
             x, weight.astype(x.dtype), bias.astype(x.dtype), shape, self.eps,
-            self.dtype)
+            self.dtype, block_r=self.block_r, autotune=self.autotune)
 
 
 class FusedRMSNorm(nn.Module):

@@ -17,7 +17,10 @@ from apex_tpu.ops.softmax import (  # noqa: F401
     scaled_masked_softmax,
     scaled_upper_triang_masked_softmax,
 )
-from apex_tpu.ops.xentropy import softmax_cross_entropy_with_smoothing  # noqa: F401
+from apex_tpu.ops.fused_ce import (  # noqa: F401
+    softmax_cross_entropy_reference,
+    softmax_cross_entropy_with_smoothing,
+)
 from apex_tpu.ops.mlp import mlp_forward  # noqa: F401
 from apex_tpu.ops.flash_attention import flash_attention  # noqa: F401
 from apex_tpu.ops.lm_head_ce import fused_lm_head_cross_entropy  # noqa: F401

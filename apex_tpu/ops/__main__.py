@@ -21,9 +21,11 @@ Examples::
 Shape specs are ``key=value`` comma lists — flash: ``b,h,s`` (or
 ``sq``/``sk``), ``d``, ``dtype``, ``causal/bias/dropout/segments``;
 lm_head_ce: ``n,v,h,dtype,smoothing``; decode_attention (the serve
-KV-cache page-size sweep): ``b,kv,group,s,d,dtype,fp8``. Flash sweeps
-tune the forward and backward INDEPENDENTLY (two cache entries per
-shape).
+KV-cache page-size sweep): ``b,kv,group,s,d,dtype,fp8``;
+fused_layer_norm: ``n,h,dtype``; xentropy: ``n,v,dtype,smoothing``;
+multi_tensor_update (the fused optimizer sweep; fp32 by contract):
+``n,lamb``. Flash sweeps tune the forward and backward INDEPENDENTLY
+(two cache entries per shape).
 """
 
 from __future__ import annotations
@@ -38,9 +40,15 @@ def _cmd_tune(args) -> int:
     from apex_tpu.tune.cache import TuneCache
 
     cache = TuneCache(directory=args.cache)
-    kernels = (["flash_attention", "lm_head_ce", "decode_attention"]
+    kernels = (["flash_attention", "lm_head_ce", "decode_attention",
+                "fused_layer_norm", "xentropy", "multi_tensor_update"]
                if args.kernel == "all" else [args.kernel])
     if args.list:
+        print("tunable kernels (default sweep shapes):")
+        for kernel, specs in sorted(tk.DEFAULT_SHAPES.items()):
+            for spec in specs:
+                fields = ",".join(f"{k}={v}" for k, v in spec.items())
+                print(f"  {kernel}  {fields}")
         print(f"cache: {cache.path} (device_kind={cache.device_kind})")
         for key, row in sorted(cache.entries().items()):
             cfg = row.get("config", {})
@@ -49,10 +57,14 @@ def _cmd_tune(args) -> int:
             print(f"  {key}  ->  {cfg}{ms_s}  (swept {row.get('swept', '?')})")
         return 0
 
-    # route each --shapes spec to the kernel whose fields it names
-    # (flash wants sq/sk/d, lm_head_ce wants n/v/h — disjoint, so a
-    # spec matches exactly one); with --kernel all and no --shapes,
-    # every kernel sweeps its bench-model defaults
+    # route each --shapes spec to the FIRST selected kernel (in the
+    # --kernel all order above) that accepts its fields. The field sets
+    # overlap since r13 (lm_head_ce n/v/h ⊃ xentropy n/v ⊃
+    # multi_tensor_update n), so an under-specified spec can route to a
+    # later kernel instead of erroring — the per-sweep banner names the
+    # kernel that actually runs; pass --kernel explicitly to pin it.
+    # With --kernel all and no --shapes, every kernel sweeps its
+    # bench-model defaults.
     per_kernel: dict = {k: [] for k in kernels}
     for s in args.shapes or []:
         errors = []
@@ -114,7 +126,8 @@ def main(argv=None) -> int:
     t = sub.add_parser("tune", help="measure-and-cache block autotuning")
     t.add_argument("--kernel", default="all",
                    choices=["all", "flash_attention", "lm_head_ce",
-                            "decode_attention"])
+                            "decode_attention", "fused_layer_norm",
+                            "xentropy", "multi_tensor_update"])
     t.add_argument("--shapes", action="append", metavar="SPEC",
                    help="key=value,... shape spec (repeatable); default: "
                         "the bench model shapes")

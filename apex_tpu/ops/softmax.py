@@ -8,6 +8,13 @@ TPU: fp32-stable fused softmax in one jit region; no seqlen cap. Backward
 uses the standard softmax VJP expressed through ``jax.custom_vjp`` to
 guarantee the fused recompute-free form (y, dy -> y*(dy - sum(dy*y)))
 matching the reference backward kernel.
+
+NOTE (ISSUE 13): when the softmax feeds a cross-entropy loss, do not
+compose these with a separate CE — the fused softmax-CE (Pallas kernel
++ reference twin) in :mod:`apex_tpu.ops.fused_ce` computes loss and
+gradient without materializing probabilities; this module remains for
+the attention-score use (``transformer/functional/fused_softmax.py``),
+where the softmax output itself is consumed.
 """
 
 from __future__ import annotations

@@ -1,86 +1,14 @@
-"""Fused softmax cross entropy with label smoothing.
+"""DEPRECATED shim — the fused softmax cross entropy lives in
+:mod:`apex_tpu.ops.fused_ce` (the ONE implementation: Pallas kernels +
+the pure-XLA reference twin, resolved through ``apex_tpu.tune``).
 
-Reference: ``apex/contrib/csrc/xentropy/xentropy_kernel.cu`` (721 LoC) via
-``apex/contrib/xentropy/softmax_xentropy.py:4-31``: one kernel computes
-``(losses, max_log_sum_exp)`` from logits+labels with smoothing; backward
-reconstructs the softmax from the saved logsumexp instead of storing
-probabilities (half the activation memory of the naive composition).
-
-TPU: same trick — custom VJP saving only ``lse`` (and the inputs), with
-the backward recomputing ``softmax = exp(logits - lse)`` in fp32.
+This module re-exports the public surface unchanged so historical
+imports keep working (the pyprof-shim precedent from PR 2); new code
+should import from ``apex_tpu.ops.fused_ce`` directly.
 """
 
-from __future__ import annotations
-
-import functools
-
-import jax
-import jax.numpy as jnp
-
-from apex_tpu.amp.policy import dtype_transparent
-
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
-@dtype_transparent('log-sum-exp reduces in fp32; grad emitted in logits dtype')
-def softmax_cross_entropy_with_smoothing(logits, labels, smoothing=0.0,
-                                         padding_idx: int | None = None):
-    """Per-example loss. ``logits``: [..., V]; ``labels``: int [...].
-
-    With smoothing s: loss = (1-s)·nll(target) + s·mean_v(nll(v)).
-    ``padding_idx`` rows get zero loss (reference's padding handling).
-    """
-    loss, _ = _xent_fwd(logits, labels, smoothing, padding_idx)
-    return loss
-
-
-def _lse(logits32):
-    m = jnp.max(logits32, axis=-1, keepdims=True)
-    return (m + jnp.log(jnp.sum(jnp.exp(logits32 - m), axis=-1, keepdims=True)))[..., 0]
-
-
-def _xent_fwd(logits, labels, smoothing, padding_idx):
-    logits32 = logits.astype(jnp.float32)
-    lse = _lse(logits32)
-    target_logit = jnp.take_along_axis(logits32, labels[..., None], axis=-1)[..., 0]
-    nll = lse - target_logit
-    if smoothing > 0.0:
-        v = logits.shape[-1]
-        mean_logit = jnp.mean(logits32, axis=-1)
-        smooth_loss = lse - mean_logit
-        loss = (1.0 - smoothing) * nll + smoothing * smooth_loss
-        del v
-    else:
-        loss = nll
-    if padding_idx is not None:
-        loss = jnp.where(labels == padding_idx, 0.0, loss)
-    return loss, (logits, labels, lse)
-
-
-def _xent_bwd(smoothing, padding_idx, res, dloss):
-    logits, labels, lse = res
-    logits32 = logits.astype(jnp.float32)
-    probs = jnp.exp(logits32 - lse[..., None])
-    v = logits.shape[-1]
-    one_hot = jax.nn.one_hot(labels, v, dtype=jnp.float32)
-    if smoothing > 0.0:
-        target = (1.0 - smoothing) * one_hot + smoothing / v
-    else:
-        target = one_hot
-    g = probs - target
-    if padding_idx is not None:
-        g = jnp.where((labels == padding_idx)[..., None], 0.0, g)
-    g = g * dloss[..., None].astype(jnp.float32)
-    return g.astype(logits.dtype), None
-
-
-softmax_cross_entropy_with_smoothing.defvjp(_xent_fwd, _xent_bwd)
-
-
-class SoftmaxCrossEntropyLoss:
-    """Module-style wrapper mirroring ``apex.contrib.xentropy.SoftmaxCrossEntropyLoss``
-    (``apex/contrib/xentropy/softmax_xentropy.py:4``)."""
-
-    @staticmethod
-    def apply(logits, labels, smoothing=0.0, padding_idx=0, half_to_float=False):
-        loss = softmax_cross_entropy_with_smoothing(logits, labels, smoothing, padding_idx)
-        return loss.astype(jnp.float32) if half_to_float else loss.astype(logits.dtype)
+from apex_tpu.ops.fused_ce import (  # noqa: F401
+    SoftmaxCrossEntropyLoss,
+    softmax_cross_entropy_reference,
+    softmax_cross_entropy_with_smoothing,
+)

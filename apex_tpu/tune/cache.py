@@ -44,7 +44,10 @@ ENV_CACHE_DIR = "APEX_TPU_TUNE_CACHE"
 CONFIG_KEYS = {"flash_attention_fwd": frozenset(("block_q", "block_k")),
                "flash_attention_bwd": frozenset(("block_q", "block_k")),
                "lm_head_ce": frozenset(("block_t", "block_v")),
-               "decode_attention": frozenset(("block_kv",))}
+               "decode_attention": frozenset(("block_kv",)),
+               "fused_layer_norm": frozenset(("block_r",)),
+               "xentropy": frozenset(("block_t", "block_v")),
+               "multi_tensor_update": frozenset(("block_n",))}
 
 
 def _pow2_ceil(x: int) -> int:
@@ -90,6 +93,14 @@ def shape_bucket(kernel: str, shape: dict) -> str:
         bkv = _pow2_ceil(shape.get("b", 1) * shape.get("kv", 1))
         return (f"bkv{bkv}_s{_pow2_ceil(shape['s'])}_d{shape['d']}"
                 f"_g{shape.get('group', 1)}")
+    if kernel == "fused_layer_norm":
+        # rows bucket pow2; the hidden size is pinned exactly (it is
+        # the lane extent the row block trades VMEM against)
+        return f"n{_pow2_ceil(shape['n'])}_h{shape['h']}"
+    if kernel == "xentropy":
+        return f"n{_pow2_ceil(shape['n'])}_v{_pow2_ceil(shape['v'])}"
+    if kernel == "multi_tensor_update":
+        return f"n{_pow2_ceil(shape['n'])}"
     raise ValueError(f"unknown kernel {kernel!r}")
 
 

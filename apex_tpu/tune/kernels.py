@@ -44,6 +44,22 @@ DEFAULT_SHAPES = {
         dict(b=16, kv=16, group=1, s=1024, d=64, dtype="bfloat16",
              fp8=True),
     ],
+    # the r13 kernels (ISSUE 13): LN at the GPT/BERT bench geometries,
+    # fused CE at the BERT logits shape (the GPT path goes through
+    # lm_head_ce), and the optimizer sweep at a GPT-125M-sized flat
+    # shard per rank (world=8) and the whole-model shard (world=1)
+    "fused_layer_norm": [
+        dict(n=8192, h=1024, dtype="bfloat16"),
+        dict(n=16384, h=768, dtype="bfloat16"),
+    ],
+    "xentropy": [
+        dict(n=16384, v=30522, dtype="bfloat16"),
+        dict(n=16384, v=30522, dtype="bfloat16", smoothing=True),
+    ],
+    "multi_tensor_update": [
+        dict(n=16 * 1024 * 1024, dtype="float32"),
+        dict(n=128 * 1024 * 1024, dtype="float32", lamb=True),
+    ],
 }
 
 
@@ -62,9 +78,18 @@ def parse_shape_spec(kernel: str, spec: str) -> dict:
                  "dropout", "segments"}
     elif decode:
         known = {"b", "kv", "group", "s", "d", "dtype", "fp8"}
+    elif kernel == "fused_layer_norm":
+        known = {"n", "h", "dtype"}
+    elif kernel == "xentropy":
+        known = {"n", "v", "dtype", "smoothing"}
+    elif kernel == "multi_tensor_update":
+        known = {"n", "dtype", "lamb"}
     else:
         known = {"n", "v", "h", "dtype", "smoothing"}
-    out: dict = {"dtype": "bfloat16"}
+    # the optimizer update is fp32 math by contract (zero/update.py);
+    # every other kernel defaults to the bf16 fast path
+    out: dict = {"dtype": "float32" if kernel == "multi_tensor_update"
+                 else "bfloat16"}
     for part in spec.split(","):
         part = part.strip()
         if not part:
@@ -86,7 +111,7 @@ def parse_shape_spec(kernel: str, spec: str) -> dict:
                                  f"{sorted(_DTYPES)})")
             out[k] = dt
         elif k in ("causal", "bias", "dropout", "segments", "smoothing",
-                   "fp8"):
+                   "fp8", "lamb"):
             out[k] = val.strip() not in ("0", "false", "False", "")
         elif k == "s" and flash:
             out["sq"] = out["sk"] = int(val)
@@ -105,6 +130,17 @@ def parse_shape_spec(kernel: str, spec: str) -> dict:
         for req in ("sq", "sk", "d"):
             if req not in out:
                 raise ValueError(f"flash shape spec needs {req} (or s)")
+    elif kernel == "fused_layer_norm":
+        for req in ("n", "h"):
+            if req not in out:
+                raise ValueError(f"fused_layer_norm shape spec needs {req}")
+    elif kernel == "xentropy":
+        for req in ("n", "v"):
+            if req not in out:
+                raise ValueError(f"xentropy shape spec needs {req}")
+    elif kernel == "multi_tensor_update":
+        if "n" not in out:
+            raise ValueError("multi_tensor_update shape spec needs n")
     else:
         for req in ("n", "v", "h"):
             if req not in out:
@@ -127,6 +163,10 @@ def split_shape(kernel: str, spec: dict):
                  for k in ("causal", "bias", "dropout", "segments")}
     elif kernel == "decode_attention":
         flags = {"fp8": bool(spec.pop("fp8", False))}
+    elif kernel == "fused_layer_norm":
+        flags = {}
+    elif kernel == "multi_tensor_update":
+        flags = {"lamb": bool(spec.pop("lamb", False))}
     else:
         flags = {"smoothing": bool(spec.pop("smoothing", False))}
     spec["itemsize"] = _np_dtype(dtype).itemsize
@@ -275,10 +315,99 @@ def build_decode_attention(shape: dict, dtype: str, flags: dict, *,
     return build
 
 
+def build_fused_layer_norm(shape: dict, dtype: str, flags: dict, *,
+                           interpret: Optional[bool] = None):
+    """``build(config)``: jitted fwd+bwd of the fused LN at the
+    candidate ``block_r`` — the kernel pair shares the knob, so the
+    sweep times them together (what a train step pays)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    rng = np.random.RandomState(0)
+    n, h = shape["n"], shape["h"]
+    dt = _np_dtype(dtype)
+    x = jnp.asarray(rng.randn(n, h) * 0.5, dt)
+    w = jnp.asarray(1.0 + rng.randn(h) * 0.02, jnp.float32)
+    b = jnp.asarray(rng.randn(h) * 0.02, jnp.float32)
+
+    def build(config):
+        from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+
+        def loss(x, w, b):
+            y = fused_layer_norm_affine(
+                x, w, b, (h,), block_r=config["block_r"],
+                interpret=interpret, out_dtype=dt)
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+
+        fn = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+        return lambda: jax.block_until_ready(fn(x, w, b))
+    return build
+
+
+def build_xentropy(shape: dict, dtype: str, flags: dict, *,
+                   interpret: Optional[bool] = None):
+    """``build(config)``: jitted fwd+bwd of the fused softmax-CE at the
+    candidate (block_t, block_v)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    rng = np.random.RandomState(0)
+    n, v_ = shape["n"], shape["v"]
+    dt = _np_dtype(dtype)
+    logits = jnp.asarray(rng.randn(n, v_) * 0.1, dt)
+    labels = jnp.asarray(rng.randint(0, v_, (n,)), jnp.int32)
+    smoothing = 0.1 if flags.get("smoothing") else 0.0
+
+    def build(config):
+        from apex_tpu.ops.fused_ce import softmax_cross_entropy_with_smoothing
+
+        def loss(logits):
+            return jnp.mean(softmax_cross_entropy_with_smoothing(
+                logits, labels, smoothing,
+                block_t=config["block_t"], block_v=config["block_v"],
+                interpret=interpret))
+
+        fn = jax.jit(jax.value_and_grad(loss))
+        return lambda: jax.block_until_ready(fn(logits))
+    return build
+
+
+def build_multi_tensor_update(shape: dict, dtype: str, flags: dict, *,
+                              interpret: Optional[bool] = None):
+    """``build(config)``: one jitted fused shard update (Adam or the
+    LAMB term) over a synthetic flat fp32 shard at the candidate
+    ``block_n`` chunk."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    rng = np.random.RandomState(0)
+    n = shape["n"]
+    p = jnp.asarray(rng.randn(n) * 0.05, jnp.float32)
+    g = jnp.asarray(rng.randn(n) * 0.01, jnp.float32)
+    m = jnp.asarray(rng.randn(n) * 0.001, jnp.float32)
+    v = jnp.asarray(np.abs(rng.randn(n)) * 1e-4, jnp.float32)
+    step = jnp.asarray(7, jnp.int32)
+    kind = "lamb" if flags.get("lamb") else "adam"
+
+    def build(config):
+        from apex_tpu.zero.fused_update import fused_shard_update
+
+        fn = jax.jit(lambda p, g, m, v: fused_shard_update(
+            p, g, m, v, step, kind=kind, lr=1e-3, betas=(0.9, 0.999),
+            eps=1e-8, weight_decay=0.01, adam_w_mode=True,
+            bias_correction=True, block_n=config["block_n"],
+            interpret=interpret))
+        return lambda: jax.block_until_ready(fn(p, g, m, v))
+    return build
+
+
 _BUILDERS = {"flash_attention_fwd": build_flash_fwd,
              "flash_attention_bwd": build_flash_bwd,
              "lm_head_ce": build_lm_head_ce,
-             "decode_attention": build_decode_attention}
+             "decode_attention": build_decode_attention,
+             "fused_layer_norm": build_fused_layer_norm,
+             "xentropy": build_xentropy,
+             "multi_tensor_update": build_multi_tensor_update}
 
 
 def tune_one(kernel: str, shape: dict, dtype: str, flags: dict, *,

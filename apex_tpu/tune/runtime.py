@@ -120,6 +120,18 @@ def _config_sane(kernel: str, cfg: dict, shape: dict, flags: dict) -> bool:
             return vmem.fits(kernel, block_kv=cfg["block_kv"],
                              d=shape["d"], group=shape.get("group", 1),
                              itemsize=itemsize)
+        if kernel == "fused_layer_norm":
+            return vmem.fits(kernel, block_r=cfg["block_r"],
+                             h=shape["h"], itemsize=itemsize)
+        if kernel == "xentropy":
+            return vmem.fits(kernel, block_t=cfg["block_t"],
+                             block_v=cfg["block_v"], itemsize=itemsize)
+        if kernel == "multi_tensor_update":
+            # the kernel views the flat shard as [rows, 128]: a chunk
+            # must cover whole fp32 (8, 128) tiles
+            return (cfg["block_n"] % 1024 == 0
+                    and vmem.fits(kernel, block_n=cfg["block_n"],
+                                  itemsize=itemsize))
         return False
     except Exception:
         return False

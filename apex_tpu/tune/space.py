@@ -24,6 +24,15 @@ _CE_BLOCK_V = (8192, 4096, 2048, 1024, 512, 256, 128)
 # allocation granule — smaller pages waste less tail capacity per
 # sequence, larger pages cut program count. 8-sublane aligned.
 _DECODE_BLOCKS = (512, 256, 128, 64, 32, 16)
+# row blocks for the fused LayerNorm kernel pair (fwd+bwd share the
+# knob): bigger blocks amortize per-program overhead, smaller ones trade
+# VMEM for h — the envelope prunes per shape
+_LN_BLOCKS = (2048, 1024, 512, 256, 128, 64, 32, 16, 8)
+# flat-shard chunks for the multi-tensor optimizer update; must stay a
+# multiple of one fp32 VMEM tile (8 sublanes x 128 lanes = 1024 elts)
+# because the kernel views the flat buffer as [rows, 128]
+_MTU_BLOCKS = (262144, 131072, 65536, 32768, 16384, 8192, 4096, 2048,
+               1024)
 
 
 def _pow2_ceil(x: int) -> int:
@@ -89,6 +98,41 @@ def decode_attention_space(*, s: int, d: int, group: int = 1,
     return out
 
 
+def fused_layer_norm_space(*, n: int, h: int,
+                           itemsize: int = 2) -> list[dict]:
+    """Legal ``{"block_r"}`` row-block candidates for the fused LN
+    kernel pair (forward and single-pass backward share the knob)."""
+    out = []
+    for br in _clip_menu(_LN_BLOCKS, n):
+        if vmem.fits("fused_layer_norm", block_r=br, h=h,
+                     itemsize=itemsize):
+            out.append({"block_r": br})
+    return out
+
+
+def xentropy_space(*, n: int, v: int, itemsize: int = 2) -> list[dict]:
+    """Legal ``{"block_t", "block_v"}`` candidates for the fused
+    softmax-CE kernels (fwd/bwd share the tiling, like lm_head_ce)."""
+    out = []
+    for bt in _clip_menu(_CE_BLOCK_T, n):
+        for bv in _clip_menu(_CE_BLOCK_V, v):
+            if vmem.fits("xentropy", block_t=bt, block_v=bv,
+                         itemsize=itemsize):
+                out.append({"block_t": bt, "block_v": bv})
+    return out
+
+
+def multi_tensor_update_space(*, n: int, itemsize: int = 4) -> list[dict]:
+    """Legal ``{"block_n"}`` flat-shard chunk candidates for the fused
+    multi-tensor optimizer update."""
+    out = []
+    for bn in _clip_menu(_MTU_BLOCKS, max(n, _MTU_BLOCKS[-1])):
+        if vmem.fits("multi_tensor_update", block_n=bn,
+                     itemsize=itemsize):
+            out.append({"block_n": bn})
+    return out
+
+
 def config_space(kernel: str, shape: dict,
                  flags: Optional[dict] = None) -> list[dict]:
     """Dispatch on the cache's kernel naming: ``flash_attention_fwd``,
@@ -110,4 +154,13 @@ def config_space(kernel: str, shape: dict,
     if kernel == "lm_head_ce":
         return lm_head_ce_space(n=shape["n"], v=shape["v"], h=shape["h"],
                                 itemsize=shape.get("itemsize", 2))
+    if kernel == "fused_layer_norm":
+        return fused_layer_norm_space(n=shape["n"], h=shape["h"],
+                                      itemsize=shape.get("itemsize", 2))
+    if kernel == "xentropy":
+        return xentropy_space(n=shape["n"], v=shape["v"],
+                              itemsize=shape.get("itemsize", 2))
+    if kernel == "multi_tensor_update":
+        return multi_tensor_update_space(
+            n=shape["n"], itemsize=shape.get("itemsize", 4))
     raise ValueError(f"unknown kernel {kernel!r}; known: {vmem.KERNELS}")

@@ -39,7 +39,8 @@ FLASH_VMEM_BUDGET = 12 * 1024 * 1024
 LM_HEAD_VMEM_LIMIT = 64 * 1024 * 1024
 
 KERNELS = ("flash_attention_fwd", "flash_attention_bwd", "lm_head_ce",
-           "decode_attention")
+           "decode_attention", "fused_layer_norm", "xentropy",
+           "multi_tensor_update")
 
 # Donation-worthiness threshold for the APXJ105 lint check (and anyone
 # else asking "is this state big enough that an undonated round trip
@@ -48,6 +49,12 @@ KERNELS = ("flash_attention_fwd", "flash_attention_bwd", "lm_head_ce",
 # or past it doubles real HBM when a jitted step threads it undonated
 # (input buffers stay alive while the outputs are written).
 DONATION_BYTES_MIN = FLASH_VMEM_BUDGET
+
+
+def ceil_to(x: int, m: int) -> int:
+    """Smallest multiple of ``m`` >= ``x`` — the ONE ceil-to-multiple
+    used by the kernel wrappers' block/pad alignment math (jax-free)."""
+    return -(-x // m) * m
 
 
 def aval_nbytes(aval) -> int:
@@ -76,7 +83,10 @@ def tree_nbytes(tree) -> int:
 
 def budget_for(kernel: str) -> int:
     if kernel in ("flash_attention_fwd", "flash_attention_bwd",
-                  "decode_attention"):
+                  "decode_attention", "fused_layer_norm", "xentropy",
+                  "multi_tensor_update"):
+        # the r13 kernels run under Mosaic's unraised scoped-VMEM
+        # default, so they share the flash envelope budget
         return FLASH_VMEM_BUDGET
     if kernel == "lm_head_ce":
         return LM_HEAD_VMEM_LIMIT
@@ -96,7 +106,8 @@ def vmem_estimate(kernel: str, *, block_q: int = 0, block_k: int = 0,
                   d: int = 0, block_t: int = 0, block_v: int = 0,
                   h: int = 0, itemsize: int = 2, bias: bool = False,
                   dropout: bool = False, segments: bool = False,
-                  block_kv: int = 0, group: int = 8) -> int:
+                  block_kv: int = 0, group: int = 8,
+                  block_r: int = 0, block_n: int = 0) -> int:
     """Estimated resident VMEM bytes for one kernel program at the given
     block config. Flash kernels take ``block_q/block_k/d``; ``lm_head_ce``
     takes ``block_t/block_v/h``. ``itemsize`` is the operand dtype's.
@@ -132,6 +143,31 @@ def vmem_estimate(kernel: str, *, block_q: int = 0, block_k: int = 0,
         acc = g8 * d * 4 + 2 * g8 * 4
         tile = g8 * block_kv * 4
         return kv_blocks + q_out + acc + tile
+    if kernel == "fused_layer_norm":
+        # single-pass backward dominates: double-buffered x + dy operand
+        # blocks, the dx output block, ~4 fp32 row-block temps the
+        # compiler keeps live (x32/dy32/xhat/dxhat before reuse), and
+        # the [1, h] fp32 dgamma/dbeta accumulators + weight row
+        operands = 2 * 2 * block_r * h * itemsize
+        dx = 2 * block_r * h * itemsize
+        temps = 4 * block_r * h * 4
+        rows = 3 * h * 4
+        return operands + dx + temps + rows
+    if kernel == "xentropy":
+        # backward dominates: two fp32 [block_t, block_v] tiles (the
+        # recomputed probabilities and the gradient tile live together),
+        # double-buffered logits operand + dlogits output blocks, and
+        # the lane-thin per-token vectors (m/l/dl/tgt) in the headroom
+        tiles = 2 * block_t * block_v * 4
+        operands = 2 * block_t * block_v * itemsize
+        dlogits = 2 * block_t * block_v * itemsize
+        vectors = 8 * block_t * 4
+        return tiles + operands + dlogits + vectors
+    if kernel == "multi_tensor_update":
+        # one blocked chunk of the flat shard: 4 double-buffered fp32
+        # inputs (p/g/m/v), 3 double-buffered fp32 outputs, plus ~4
+        # elementwise temps before Mosaic's buffer reuse kicks in
+        return (2 * 4 + 2 * 3 + 4) * block_n * 4
     if kernel == "lm_head_ce":
         # the _pick_blocks budget math, promoted: fp32 dE accumulator
         # block + fp32 logits tile + double-buffered E/x operand blocks

@@ -77,7 +77,8 @@ class ZeroOptimizer:
                  use_nvlamb: bool = False,
                  axis_name: str = "data", overlap_comm: bool = False,
                  compress_allgather: bool | str = False,
-                 spec: ZeroSpec | None = None):
+                 spec: ZeroSpec | None = None,
+                 autotune: str | None = None):
         if kind not in ("adam", "lamb"):
             raise ValueError(f"kind must be 'adam' or 'lamb', got {kind!r}")
         self.kind = kind
@@ -101,6 +102,14 @@ class ZeroOptimizer:
                 f"compress_allgather must be False, True or 'scaled', "
                 f"got {compress_allgather!r}")
         self.compress_allgather = compress_allgather
+        # fused multi-tensor update resolution (zero/fused_update.py):
+        # explicit policy > $APEX_TPU_AUTOTUNE > "cache"; no tuned entry
+        # (or "off") keeps the historical tree-map/flat-jnp update
+        # bit-for-bit. Validated eagerly so a typo fails at construction.
+        if autotune is not None:
+            from apex_tpu.tune import runtime as _tune_rt
+            _tune_rt.resolve_policy(autotune)
+        self.autotune = autotune
         self._zspec = spec
         self._spec: FlatBuffer | None = None   # tier-1/2 flat layout
 
@@ -119,6 +128,21 @@ class ZeroOptimizer:
         weight store, so there is nothing to switch on — just keep the
         scaler for the stateful conveniences."""
         self._scaler = scaler
+
+    def _fused_cfg(self, n: int):
+        """Tuned ``multi_tensor_update`` chunk config for an ``n``-element
+        fp32 sweep, or ``None`` (use the tree-map/flat-jnp path). Runs at
+        trace time; resolution order and telemetry are the shared
+        ``tune.runtime`` contract the flash/LN/CE kernels use."""
+        from apex_tpu.tune import runtime as _tune_rt
+        from apex_tpu.zero.fused_update import _resolve_interpret
+        policy = _tune_rt.resolve_policy(self.autotune)
+        if policy == "off" or n <= 0:
+            return None
+        return _tune_rt.resolve(
+            "multi_tensor_update", {"n": int(n), "itemsize": 4},
+            "float32", {"lamb": self.kind == "lamb"}, policy=policy,
+            interpret=_resolve_interpret(None))
 
     # -- dispatch -----------------------------------------------------------
     def init(self, params, spec: ZeroSpec | None = None):
@@ -234,17 +258,34 @@ class ZeroOptimizer:
                 g_shard = g_shard / jnp.maximum(
                     1.0, gnorm / self.max_grad_norm)
 
+        fused = self._fused_cfg(per)
+
         def _do(state=state, g=g_shard, lr=lr):
             step = state.step + 1
             p = state.master_shard
             if self.kind == "adam":
-                new_p, m, v = adam_shard_step(
-                    p, g, state.m_shard, state.v_shard, step, lr=lr,
-                    **self._hyper())
+                if fused is not None:
+                    from apex_tpu.zero.fused_update import fused_shard_update
+                    new_p, m, v = fused_shard_update(
+                        p, g, state.m_shard, state.v_shard, step,
+                        kind="adam", lr=lr, block_n=fused["block_n"],
+                        **self._hyper())
+                else:
+                    new_p, m, v = adam_shard_step(
+                        p, g, state.m_shard, state.v_shard, step, lr=lr,
+                        **self._hyper())
                 return type(state)(step, new_p, m, v)
-            upd, m, v = lamb_shard_term(
-                p, g, state.m_shard, state.v_shard, step,
-                grad_averaging=self.gradient_average, **self._hyper())
+            if fused is not None:
+                from apex_tpu.zero.fused_update import fused_shard_update
+                upd, m, v = fused_shard_update(
+                    p, g, state.m_shard, state.v_shard, step,
+                    kind="lamb", lr=lr,
+                    grad_averaging=self.gradient_average,
+                    block_n=fused["block_n"], **self._hyper())
+            else:
+                upd, m, v = lamb_shard_term(
+                    p, g, state.m_shard, state.v_shard, step,
+                    grad_averaging=self.gradient_average, **self._hyper())
             # per-tensor norms: shard-local contiguous-range sums +
             # cross-shard psum (the allgather of update norms, :722-778)
             w_sq = _comm.psum_flat(self._range_sums(p * p, base, per),
@@ -360,23 +401,59 @@ class ZeroOptimizer:
                 g_leaves = [g_leaves[i] / clip if is_float[i]
                             else g_leaves[i] for i in range(len(g_leaves))]
 
+        # the fused multi-tensor path sweeps ALL float leaves as one
+        # concatenated flat buffer — one kernel instead of a tree-map of
+        # per-leaf op chains (elementwise, so concatenation preserves
+        # bit-parity with the per-leaf form under compilation)
+        fused = self._fused_cfg(sum(mast_leaves[i].size for i in floats)) \
+            if floats else None
+
+        def _fused_leaves(kind, step, lr):
+            from apex_tpu.zero.fused_update import fused_shard_update
+            def cat(ls):
+                return jnp.concatenate([ls[i].reshape(-1) for i in floats])
+            fo, fm, fv = fused_shard_update(
+                cat(mast_leaves), cat(g_leaves), cat(m_leaves),
+                cat(v_leaves), step, kind=kind, lr=lr,
+                grad_averaging=self.gradient_average,
+                block_n=fused["block_n"], **self._hyper())
+            out, off = {}, 0
+            for i in floats:
+                sz = mast_leaves[i].size
+                shp = mast_leaves[i].shape
+                out[i] = (fo[off:off + sz].reshape(shp),
+                          fm[off:off + sz].reshape(shp),
+                          fv[off:off + sz].reshape(shp))
+                off += sz
+            return out
+
         def _do():
             step = state.step + 1
             new_master = list(mast_leaves)
             new_m, new_v = list(m_leaves), list(v_leaves)
             if self.kind == "adam":
-                for i in floats:
-                    new_master[i], new_m[i], new_v[i] = adam_shard_step(
-                        mast_leaves[i], g_leaves[i], m_leaves[i],
-                        v_leaves[i], step, lr=lr, **self._hyper())
+                if fused is not None:
+                    for i, (o, nm, nv) in _fused_leaves("adam", step,
+                                                        lr).items():
+                        new_master[i], new_m[i], new_v[i] = o, nm, nv
+                else:
+                    for i in floats:
+                        new_master[i], new_m[i], new_v[i] = adam_shard_step(
+                            mast_leaves[i], g_leaves[i], m_leaves[i],
+                            v_leaves[i], step, lr=lr, **self._hyper())
             else:
                 upds = {}
-                for i in floats:
-                    upds[i], new_m[i], new_v[i] = lamb_shard_term(
-                        mast_leaves[i], g_leaves[i], m_leaves[i],
-                        v_leaves[i], step,
-                        grad_averaging=self.gradient_average,
-                        **self._hyper())
+                if fused is not None:
+                    for i, (o, nm, nv) in _fused_leaves("lamb", step,
+                                                        lr).items():
+                        upds[i], new_m[i], new_v[i] = o, nm, nv
+                else:
+                    for i in floats:
+                        upds[i], new_m[i], new_v[i] = lamb_shard_term(
+                            mast_leaves[i], g_leaves[i], m_leaves[i],
+                            v_leaves[i], step,
+                            grad_averaging=self.gradient_average,
+                            **self._hyper())
                 # whole-logical-tensor norms from shard partials
                 zero = jnp.zeros((), jnp.float32)
                 w_sq = self._masked_psum_merge(

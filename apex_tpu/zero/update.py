@@ -8,6 +8,11 @@ collectives; the element math lives here so the tiers cannot drift.
 Everything is elementwise fp32 (the MXU-free part of the step), shaped
 agnostically — callers pass 1-D flat shards or leaf-shaped arrays alike.
 
+``zero/fused_update.py`` is this module's Pallas kernel twin (ISSUE 13):
+one blocked sweep of the flat shard with the SAME op sequence —
+bit-identical under compilation, engaged when the tuned cache has a
+``multi_tensor_update`` entry. Change the math here and there together.
+
 State layouts:
 
 - :class:`ShardedAdamState` / :class:`ShardedLambState` — the tier-1/2

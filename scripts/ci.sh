@@ -34,9 +34,10 @@
 #                    (BENCH_r05.json: rc=124, parsed: null)
 #   5. regress     — python -m apex_tpu.monitor regress: the smoke
 #                    stream must load as an evidence round, and the
-#                    committed BENCH_r01-r05 rounds must degrade exactly
-#                    as documented (r05 no-evidence, r01 incomparable)
-#                    with no false regression verdict
+#                    committed BENCH_r01-r07 rounds must degrade exactly
+#                    as documented (r05 no-evidence, r01 incomparable,
+#                    cpu-host rounds unit-marked) with no false
+#                    regression verdict
 set -uo pipefail
 cd "$(dirname "$0")/.."
 REPO_DIR="$(pwd)"
@@ -58,7 +59,8 @@ d = json.load(open(sys.argv[1]))
 eps = set(d.get("entrypoints_analyzed", []))
 tabs = set(d.get("rules_tables_checked", []))
 missing_eps = {"serve_decode_step", "serve_prefill_step",
-               "zero3_train_step", "fp8_train_step"} - eps
+               "zero3_train_step", "fp8_train_step",
+               "fused_layer_norm_step", "zero_fused_update_step"} - eps
 missing_tabs = {"serve.GPT_PARAM_RULES", "serve.CACHE_RULES",
                 "zero.DEFAULT_RULES"} - tabs
 if missing_eps or missing_tabs:
@@ -100,13 +102,14 @@ for line in open(sys.argv[1]):
     if ev.get("kind") == "section":
         seen.add(ev.get("name"))
 missing = {"tp_overlap", "ddp_bucket_overlap", "pp_zero_bubble",
-           "zero_sharded_step", "fp8_step", "autotune", "profile",
-           "serve_decode"} - seen
+           "zero_sharded_step", "fp8_step", "autotune", "fused_ln",
+           "multi_tensor_update", "profile", "serve_decode"} - seen
 if missing:
     print(f"ci: sections missing from bench stream: {sorted(missing)}")
     raise SystemExit(1)
 print("ci: tp_overlap + ddp_bucket_overlap + pp_zero_bubble + "
-      "zero_sharded_step + fp8_step + autotune + profile + serve_decode "
+      "zero_sharded_step + fp8_step + autotune + fused_ln + "
+      "multi_tensor_update + profile + serve_decode "
       "present in bench stream")
 EOF
 
@@ -116,14 +119,16 @@ echo "== ci: bench-trajectory regression gate (monitor.regress) =="
 #    are exercised on every CI run)
 python -m apex_tpu.monitor regress /tmp/ci_bench_smoke_stream.jsonl \
     --json > /tmp/ci_regress_smoke.json || fail=1
-# 2) the committed rounds must degrade exactly as documented: r05 is
-#    a no-evidence row (rc=124), r01 is incomparable with r02+ (the
-#    unit-methodology change), and no false regression fires
+# 2) the committed rounds r01-r07 must degrade exactly as documented:
+#    r05 is a no-evidence row (rc=124), r01 is incomparable with r02+
+#    (the unit-methodology change), the cpu-host rounds (r06/r07) are
+#    unit-marked so platform-bound metrics never cross-compare, and no
+#    false regression fires
 python - <<'EOF' || fail=1
 import json, subprocess, sys
 p = subprocess.run(
     [sys.executable, "-m", "apex_tpu.monitor", "regress",
-     *[f"BENCH_r0{i}.json" for i in range(1, 6)], "--json"],
+     *[f"BENCH_r0{i}.json" for i in range(1, 8)], "--json"],
     capture_output=True, text=True)
 if p.returncode != 0:
     print(f"ci: regress over committed rounds exited {p.returncode}:\n"
@@ -132,11 +137,19 @@ if p.returncode != 0:
 rep = json.loads(p.stdout)
 by = {r["round"]: r for r in rep["rounds"]}
 assert by["r05"]["status"] == "no-evidence", by["r05"]
+assert by["r07"]["status"] == "ok", by["r07"]
 inc = rep["metrics"]["value"].get("incomparable") or []
 assert any(i["round"] == "r01" for i in inc), rep["metrics"]["value"]
+# the r13 kernel cost-model keys are platform-independent: they must be
+# registered in the unit schema (not suffix-inferred driftable blanks)
+units = {k: rep["metrics"][k]["unit"] for k in rep["metrics"]
+         if k.startswith(("fused_ln_", "fused_ce_", "multi_tensor_"))}
+missing = [k for k, u in units.items() if not u]
+assert not missing, f"unregistered kernel metric units: {missing}"
 assert not rep["regressions"], rep["regressions"]
-print("ci: regress gate ok (r05 no-evidence, r01 incomparable, "
-      "no false regressions)")
+print("ci: regress gate ok over r01-r07 (r05 no-evidence, r01 "
+      "incomparable, kernel metric units registered, no false "
+      "regressions)")
 EOF
 
 if [[ "$fail" == "0" ]]; then
