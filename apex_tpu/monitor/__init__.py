@@ -16,7 +16,14 @@ storm/divergence/plateau/starvation/straggler detection as typed
 attributor + measured wall-time sampling,
 ``python -m apex_tpu.monitor profile``), bench-trajectory regression
 detection (``monitor.regress``: versioned round loader + noise-aware
-verdicts, ``python -m apex_tpu.monitor regress``), and a CLI report
+verdicts, ``python -m apex_tpu.monitor regress``), request-level span
+tracing + O(1)-memory log-scale latency histograms (``monitor.spans``:
+the serve SLO evidence layer — per-request queue-wait/prefill/decode
+traces with preempt/re-admit annotations, rendered as the ``serve``
+block of the report), a pull-based Prometheus text-exposition endpoint
+(``monitor.export``: lazily imported, ``python -m apex_tpu.monitor
+export``), MFU/goodput accounting (``monitor.profile.mfu`` over the
+analytic FLOPs walk + a per-device-kind peak table), and a CLI report
 (``python -m apex_tpu.monitor report run.jsonl``).
 
 Quick start::
@@ -56,15 +63,31 @@ from apex_tpu.monitor import hooks  # noqa: F401
 from apex_tpu.monitor import merge  # noqa: F401
 from apex_tpu.monitor import profile  # noqa: F401
 from apex_tpu.monitor import regress  # noqa: F401
+from apex_tpu.monitor import spans  # noqa: F401
 from apex_tpu.monitor import trace  # noqa: F401
 from apex_tpu.monitor import xprof  # noqa: F401
 from apex_tpu.monitor.health import Watchdog  # noqa: F401
 from apex_tpu.monitor.profile import scope  # noqa: F401
 from apex_tpu.monitor.recorder import Recorder  # noqa: F401
 from apex_tpu.monitor.report import (  # noqa: F401
-    aggregate, load_jsonl, render_cross_host, render_report, render_steps,
-    selfcheck)
+    aggregate, load_jsonl, render_cross_host, render_report, render_serve,
+    render_steps, selfcheck)
+from apex_tpu.monitor.spans import LogHistogram  # noqa: F401
 from apex_tpu.monitor.hooks import enabled, epoch  # noqa: F401
+
+
+def __getattr__(name: str):
+    # monitor.export is the ONLY lazily-imported submodule: it pulls in
+    # http.server, and the disabled-mode contract for the exporter is
+    # "no thread, no import cost" — a process that never exports never
+    # pays for the module (asserted by tests/test_export.py)
+    if name == "export":
+        import importlib
+        mod = importlib.import_module("apex_tpu.monitor.export")
+        globals()["export"] = mod
+        return mod
+    raise AttributeError(f"module 'apex_tpu.monitor' has no attribute "
+                         f"{name!r}")
 
 
 def get_recorder() -> Recorder | None:

@@ -4,6 +4,7 @@
     python -m apex_tpu.monitor merge SHARD... [--json] [-o OUT.json]
     python -m apex_tpu.monitor profile [--model gpt|mlp] [--measured]
     python -m apex_tpu.monitor regress RUNS... [--against BASELINE.json]
+    python -m apex_tpu.monitor export run.jsonl [--once [--check]|--port N]
     python -m apex_tpu.monitor selfcheck [--steps N]
 
 ``report`` renders the per-step and aggregate tables from a
@@ -21,8 +22,18 @@ the old ``scripts/profile_gpt.py``). ``regress`` loads bench evidence
 rounds (driver ``BENCH_r*.json`` wrappers, assembled bench JSON, or
 ``bench_stream.jsonl`` streams), degrades per round, and renders
 noise-aware verdicts — exit status is non-zero only on a confirmed
-regression. ``selfcheck`` records a synthetic 3-step amp run on CPU
-and asserts the dump → report round trip (used by ``scripts/ci.sh``).
+regression. ``export`` renders a recorder JSONL dump/stream as
+Prometheus text exposition — ``--once`` to stdout (``--check``
+additionally parses the output back and asserts scrape == aggregate;
+the ``scripts/ci.sh`` export stage), otherwise served over HTTP with
+the file re-read per scrape. ``selfcheck`` records a synthetic 3-step
+amp run on CPU and asserts the dump → report round trip (used by
+``scripts/ci.sh``).
+
+``profile`` also reports **MFU** (model FLOPs utilization): the
+analytic step FLOPs divided by measured wall time and the
+per-``device_kind`` peak-FLOPs table (``--peak-tflops`` overrides the
+table; ``--no-mfu`` skips the timed execution).
 """
 
 from __future__ import annotations
@@ -85,6 +96,15 @@ def main(argv=None) -> int:
                          "scripts/profile_gpt.py output)")
     pp.add_argument("--json", action="store_true")
     pp.add_argument("--max-rows", type=int, default=40)
+    pp.add_argument("--mfu-repeats", type=int, default=3,
+                    help="timed executions of the step for the MFU "
+                         "wall-time denominator (median taken)")
+    pp.add_argument("--peak-tflops", type=float, default=None,
+                    help="peak TFLOP/s override for the MFU "
+                         "denominator (default: the per-device_kind "
+                         "table in monitor.profile)")
+    pp.add_argument("--no-mfu", action="store_true",
+                    help="skip the timed step execution + MFU line")
 
     pg = sub.add_parser("regress",
                         help="bench-trajectory verdicts over evidence "
@@ -103,6 +123,19 @@ def main(argv=None) -> int:
     pg.add_argument("--min-history", type=int, default=3,
                     help="comparable prior rounds required before a "
                          "regression verdict can gate")
+
+    pe = sub.add_parser("export",
+                        help="Prometheus text exposition from a "
+                             "recorder JSONL dump/stream")
+    pe.add_argument("path", help="Recorder.dump_jsonl file or "
+                                 "bench/serve evidence stream")
+    pe.add_argument("--once", action="store_true",
+                    help="render one snapshot to stdout and exit")
+    pe.add_argument("--check", action="store_true",
+                    help="with --once: parse the emitted text back and "
+                         "assert scrape == aggregate (CI self-check)")
+    pe.add_argument("--port", type=int, default=9464)
+    pe.add_argument("--addr", default="127.0.0.1")
 
     ps = sub.add_parser("selfcheck",
                         help="record a synthetic run; assert round-trip")
@@ -154,6 +187,10 @@ def main(argv=None) -> int:
             print(regress_mod.render_regress(rep))
         return rep["exit_code"]
 
+    if args.cmd == "export":
+        from apex_tpu.monitor import export as export_mod
+        return export_mod.main(args)
+
     if args.cmd == "profile":
         return _run_profile(args)
 
@@ -179,12 +216,22 @@ def _run_profile(args) -> int:
     if args.measured:
         measured = profile_mod.measured_profile(step, *step_args,
                                                 repeats=args.repeats)
+    mfu_row = None
+    if not args.no_mfu:
+        peak = (args.peak_tflops * 1e12
+                if args.peak_tflops is not None else None)
+        mfu_row = profile_mod.measured_mfu(
+            step, step_args, flops=prof["total"]["flops"], peak=peak,
+            repeats=args.mfu_repeats)
     if args.json:
         print(json.dumps(json_safe(
-            {"analytic": prof, "measured": measured}), indent=2))
+            {"analytic": prof, "measured": measured,
+             "mfu": mfu_row}), indent=2))
     else:
         print(profile_mod.render_profile(prof, measured=measured,
                                          max_rows=args.max_rows))
+        if mfu_row is not None:
+            print(profile_mod.render_mfu(mfu_row))
     if args.per_op:
         # with --json, stdout must stay ONE parseable document: the
         # human-readable per-op table moves to stderr

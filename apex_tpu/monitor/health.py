@@ -23,6 +23,27 @@ mixed-precision distributed runs:
                          whose median step time exceeds the global
                          median by ``straggler_ratio``
 
+Serve-side conditions (the serve engine records its per-round gauges
+and counters inside per-step records — ``ServeEngine.step`` — so the
+same observer sees them with no serve-specific wiring):
+
+- ``kv_pool_exhaustion``   the page allocator's free list at/below
+                           ``kv_pool_min_free_fraction`` of the pool
+                           (``serve/pages_free`` vs ``serve/
+                           pages_total``) — admission and growth are
+                           about to start evicting
+- ``eviction_storm``       preemptions in >= ``eviction_trips`` of the
+                           last ``eviction_window`` steps (the
+                           ``serve/preemptions`` counter per step):
+                           the pool is thrashing — every admission
+                           evicts someone whose recompute evicts the
+                           next
+- ``admission_starvation`` the oldest waiting request's age
+                           (``serve/queue_wait_oldest_s``), EMA-
+                           smoothed, above ``admission_age_s`` — the
+                           queue head cannot be admitted (pool or
+                           batch slots too small for the traffic)
+
 Each detection emits one typed ``health_event`` record into the
 recorder — ``{"kind": "health_event", "name": <condition>, "severity",
 "diagnosis", ...}`` — which rides the JSONL dump, shows up in
@@ -45,6 +66,7 @@ from typing import Callable, Optional
 HEALTH_EVENT_KINDS = (
     "nan", "overflow_storm", "loss_divergence", "loss_plateau",
     "loader_starvation", "straggler",
+    "kv_pool_exhaustion", "eviction_storm", "admission_starvation",
 )
 
 
@@ -86,6 +108,10 @@ class Watchdog:
                  starvation_fraction: float = 0.5,
                  starvation_window: int = 5,
                  straggler_ratio: float = 1.5,
+                 kv_pool_min_free_fraction: float = 0.1,
+                 eviction_window: int = 20, eviction_trips: int = 3,
+                 admission_age_s: float = 30.0,
+                 admission_smoothing: float = 0.3,
                  diagnostics_steps: int = 16,
                  scaler=None):
         self.on_event = on_event
@@ -101,6 +127,11 @@ class Watchdog:
         self.starvation_fraction = float(starvation_fraction)
         self.starvation_window = int(starvation_window)
         self.straggler_ratio = float(straggler_ratio)
+        self.kv_pool_min_free_fraction = float(kv_pool_min_free_fraction)
+        self.eviction_window = int(eviction_window)
+        self.eviction_trips = int(eviction_trips)
+        self.admission_age_s = float(admission_age_s)
+        self.admission_smoothing = float(admission_smoothing)
         self.diagnostics_steps = int(diagnostics_steps)
         self.scaler = scaler            # optional LossScaler for bundles
         self.events: list[dict] = []
@@ -122,6 +153,13 @@ class Watchdog:
         self._starve_hist: collections.deque = collections.deque(
             maxlen=self.starvation_window)
         self._starving = False
+        # serve-side detection state
+        self._pool_low = False
+        self._evict_hist: collections.deque = collections.deque(
+            maxlen=self.eviction_window)
+        self._evict_active = False
+        self._queue_age_ema: Optional[float] = None
+        self._admission_starved = False
         self._n_steps = 0
         if recorder is not None:
             self.watch(recorder)
@@ -288,6 +326,93 @@ class Watchdog:
             elif self._starve_hist and self._starve_hist[-1] \
                     < self.starvation_fraction:
                 self._starving = False
+
+        self._serve_checks(rec, step, step_ev, gauges)
+
+    # -- serve-side analysis ------------------------------------------------
+    def _serve_checks(self, rec, step, step_ev: dict, gauges: dict):
+        """The serve engine's per-round gauges/counters ride ordinary
+        step records (``ServeEngine.step``), so serve health reuses the
+        training observer verbatim. One early-out on a non-serve step
+        record."""
+        free = gauges.get("serve/pages_free")
+        total = gauges.get("serve/pages_total")
+        if free is None and total is None \
+                and "serve/preemptions" not in (step_ev.get("counters")
+                                                or {}) \
+                and "serve/queue_wait_oldest_s" not in gauges:
+            return
+
+        # 1) kv pool exhaustion: the free list at/below the threshold
+        # fraction of the pool — the allocator is about to start
+        # evicting on every growth/admission
+        if free is not None and total and _finite(free) and _finite(total):
+            frac = float(free) / float(total)
+            if frac <= self.kv_pool_min_free_fraction:
+                if not self._pool_low:
+                    self._pool_low = True
+                    self._fire(
+                        rec, "kv_pool_exhaustion", round(frac, 4),
+                        f"KV page pool nearly exhausted at step {step}: "
+                        f"{int(free)}/{int(total)} pages free "
+                        f"({100 * frac:.0f}% <= "
+                        f"{100 * self.kv_pool_min_free_fraction:.0f}% "
+                        "threshold). Growth and admission are about to "
+                        "preempt running sequences — grow num_pages, "
+                        "shrink page_size tail waste, or enable fp8-KV "
+                        "(~2x pages at the same HBM).",
+                        severity="warn", step=step,
+                        pages_free=int(free), pages_total=int(total))
+            elif frac > 2.0 * self.kv_pool_min_free_fraction:
+                self._pool_low = False        # hysteresis: re-arm
+
+        # 2) eviction storm: preemptions in too many of the last N
+        # steps — the pool thrashes (each admission evicts a sequence
+        # whose recompute re-evicts the next; throughput collapses to
+        # re-prefill work)
+        pre = (step_ev.get("counters") or {}).get("serve/preemptions", 0)
+        if free is not None or pre:
+            self._evict_hist.append(1 if pre else 0)
+            trips = sum(self._evict_hist)
+            if trips >= self.eviction_trips and not self._evict_active:
+                self._evict_active = True
+                self._fire(
+                    rec, "eviction_storm", trips,
+                    f"preemptions fired in {trips} of the last "
+                    f"{len(self._evict_hist)} serve steps (step {step})"
+                    ": the page pool is thrashing — evicted sequences "
+                    "recompute their caches only to evict the next. "
+                    "Tokens/sec is now dominated by re-prefill; grow "
+                    "the pool or lower max_batch.",
+                    severity="error", step=step,
+                    window=len(self._evict_hist))
+            elif trips == 0:
+                self._evict_active = False
+
+        # 3) admission starvation: the oldest waiting request's age,
+        # EMA-smoothed so one slow admission round does not page anyone
+        age = gauges.get("serve/queue_wait_oldest_s")
+        if age is not None and _finite(age):
+            a = self.admission_smoothing
+            age = float(age)
+            self._queue_age_ema = age if self._queue_age_ema is None \
+                else (1.0 - a) * self._queue_age_ema + a * age
+            if self._queue_age_ema >= self.admission_age_s:
+                if not self._admission_starved:
+                    self._admission_starved = True
+                    self._fire(
+                        rec, "admission_starvation",
+                        round(self._queue_age_ema, 3),
+                        f"oldest waiting request has been queued "
+                        f"~{self._queue_age_ema:.1f}s (EMA) at step "
+                        f"{step}, over the {self.admission_age_s:g}s "
+                        "bar: FCFS admission cannot place the queue "
+                        "head — the pool or the batch slots are too "
+                        "small for the offered traffic.",
+                        severity="warn", step=step,
+                        age_ema_s=round(self._queue_age_ema, 3))
+            elif self._queue_age_ema < 0.5 * self.admission_age_s:
+                self._admission_starved = False
 
     # -- cross-host ---------------------------------------------------------
     def check_cross_host(self, merged: dict, recorder=None) -> list[dict]:

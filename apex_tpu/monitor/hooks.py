@@ -75,6 +75,16 @@ def gauge(name: str, value, **extra):
         rec.gauge(name, value, **extra)
 
 
+def observe(name: str, value, **kw):
+    """Record one sample into the attached recorder's named log-scale
+    histogram (``Recorder.observe`` — O(1) memory streaming
+    percentiles; no per-sample event). The serve engine's token-latency
+    / TTFT / queue-wait SLO numbers flow through here."""
+    rec = _state.recorder
+    if rec is not None:
+        rec.observe(name, value, **kw)
+
+
 def timer(name: str):
     """Context manager timing a host-side block; null when disabled."""
     rec = _state.recorder

@@ -16,6 +16,11 @@ Event model (one dict per event, JSONL-serializable):
 - ``collective``{name="op@axis", value=count, bytes} trace-time accounting
 - ``step``      {step, value=step_time_s, gauges, counters, collectives,
                  timers}                          one per training step
+- ``histogram`` {name, value=count, counts, ...}  cumulative snapshot of
+                 a :meth:`observe` log-scale histogram (O(1) memory; no
+                 per-sample events)
+- ``span_start``/``span_end``/``span_event``      request-level span
+                 tracing (:mod:`apex_tpu.monitor.spans`)
 
 Events live in a bounded ring (``capacity`` newest kept; ``dropped``
 counts evictions), so a recorder attached for a million steps holds
@@ -121,6 +126,7 @@ class Recorder:
         self._emitted = 0              # lifetime count (ring may evict)
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
+        self._histograms: dict[str, Any] = {}     # name -> LogHistogram
         self._collectives: dict[str, dict] = {}   # "op@axis" -> {count, bytes}
         self._lock = threading.RLock()
         self._step_idx = 0
@@ -222,6 +228,59 @@ class Recorder:
             if step is not None:
                 step["gauges"][name] = value
         self._emit("gauge", name, value, **extra)
+
+    def observe(self, name: str, value, *, lo: float = None,
+                hi: float = None, buckets_per_decade: int = None):
+        """Record one sample into the named fixed-bucket log-scale
+        histogram (:class:`~apex_tpu.monitor.spans.LogHistogram`).
+
+        Deliberately NOT one event per sample: the histogram state is
+        O(1) memory and the stream stays O(1) traffic under sustained
+        serving — percentiles (p50/p95/p99) stay queryable for the
+        whole run. Snapshots ride the ring/stream as ``histogram``
+        events via :meth:`emit_histograms` (called by the serve engine
+        at drain) and are appended automatically by
+        :meth:`dump_jsonl`/:meth:`aggregate`. The bucket-range kwargs
+        apply only on the FIRST observation of a name."""
+        from apex_tpu.monitor.spans import LogHistogram
+        value = float(value)
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                kw = {}
+                if lo is not None:
+                    kw["lo"] = lo
+                if hi is not None:
+                    kw["hi"] = hi
+                if buckets_per_decade is not None:
+                    kw["buckets_per_decade"] = buckets_per_decade
+                h = self._histograms[name] = LogHistogram(**kw)
+            h.record(value)
+
+    def histograms(self) -> dict:
+        """Live ``name -> LogHistogram`` map (the objects themselves;
+        callers wanting a stable view should use their snapshots)."""
+        with self._lock:
+            return dict(self._histograms)
+
+    def _histogram_events(self) -> list[dict]:
+        """Fresh cumulative ``histogram`` snapshot events (not stored
+        in the ring) — appended to dumps and aggregates so histograms
+        survive the JSONL round trip."""
+        with self._lock:
+            snaps = {k: h.snapshot() for k, h in self._histograms.items()}
+        return [{"kind": "histogram", "name": k, "value": snap["count"],
+                 **{kk: vv for kk, vv in snap.items() if kk != "count"}}
+                for k, snap in sorted(snaps.items())]
+
+    def emit_histograms(self):
+        """Flush one cumulative ``histogram`` snapshot event per
+        observed histogram into the ring (and the stream, when
+        streaming) — crash-resilient persistence for long runs; safe to
+        call repeatedly (snapshots are cumulative, last one wins)."""
+        for ev in self._histogram_events():
+            self._emit(ev.pop("kind"), ev.pop("name"), ev.pop("value"),
+                       **ev)
 
     def timer_event(self, name: str, seconds: float, **extra):
         with self._lock:
@@ -360,7 +419,7 @@ class Recorder:
         header = {"kind": "header", "name": self.name,
                   "capacity": self.capacity, "dropped": self.dropped,
                   "meta": self.meta}
-        evs = self.records()
+        evs = self.records() + self._histogram_events()
         if hasattr(path_or_file, "write"):
             f = path_or_file
             close = False
@@ -380,5 +439,6 @@ class Recorder:
         """Aggregated summary (the JSON the CLI report renders)."""
         from apex_tpu.monitor.report import aggregate
         _effects_barrier()
-        return aggregate(self.records(), header={
-            "name": self.name, "dropped": self.dropped, "meta": self.meta})
+        return aggregate(self.records() + self._histogram_events(),
+                         header={"name": self.name, "dropped": self.dropped,
+                                 "meta": self.meta})

@@ -88,6 +88,8 @@ def suffix_unit(name: str) -> str:
         return "imgs/sec/chip"
     if "mfu" in name:
         return "mfu"
+    if name.endswith("_pct"):
+        return "%"
     if "speedup" in name or name == "vs_baseline":
         return "ratio"
     if "loss" in name:
@@ -238,10 +240,12 @@ def metric_direction(name: str, unit: str) -> Optional[str]:
     base = unit.split(" (")[0]
     if base in ("ms", "s") or name.endswith(("_ms", "_s")) \
             or "_ms_" in name or "idle" in name or "bubble" in name \
-            or "bytes" in name or "loss" in name or base == "loss":
+            or "bytes" in name or "loss" in name or base == "loss" \
+            or "ttft" in name or "queue_wait" in name:
         return "lower"
     if "/sec" in base or base in ("mfu", "ratio") or "per_sec" in name \
-            or "speedup" in name or "mfu" in name or name == "vs_baseline":
+            or "speedup" in name or "mfu" in name or name == "vs_baseline" \
+            or "goodput" in name or "capacity_ratio" in name:
         return "higher"
     return None
 

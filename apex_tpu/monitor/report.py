@@ -58,6 +58,11 @@ def aggregate(events: Iterable[dict], header: Optional[dict] = None) -> dict:
     schedules: dict[str, dict] = {}
     utilization: dict[str, dict] = {}
     profile_rows: dict[str, dict] = {}
+    histograms: dict[str, dict] = {}   # cumulative snapshots; last wins
+    span_ends: list[dict] = []
+    span_events: dict[str, int] = {}
+    gauge_series: dict[str, list] = {}   # trajectory-tracked gauges
+    _TRACKED_GAUGES = ("serve/queue_depth", "serve/batch_fill")
     steps: list[dict] = []
     health: list[dict] = []
     for ev in events:
@@ -68,6 +73,22 @@ def aggregate(events: Iterable[dict], header: Optional[dict] = None) -> dict:
                                     + ev.get("value", 0))
         elif kind == "gauge":
             gauges[name] = ev.get("value")
+            if name in _TRACKED_GAUGES:
+                gauge_series.setdefault(name, []).append(
+                    (ev.get("t"), ev.get("value")))
+        elif kind == "histogram":
+            # cumulative LogHistogram snapshot (spans.LogHistogram):
+            # later emissions strictly contain earlier ones
+            histograms[name] = {k: ev.get(k) for k in
+                                ("lo", "hi", "buckets_per_decade", "sum",
+                                 "min", "max", "underflow", "overflow",
+                                 "counts")}
+            histograms[name]["count"] = ev.get("value")
+        elif kind == "span_end":
+            span_ends.append(ev)
+        elif kind in ("span_start", "span_event"):
+            span_events[f"{kind}:{name}"] = \
+                span_events.get(f"{kind}:{name}", 0) + 1
         elif kind == "timer":
             t = timers.setdefault(name, {"n": 0, "total_s": 0.0})
             t["n"] += 1
@@ -163,8 +184,98 @@ def aggregate(events: Iterable[dict], header: Optional[dict] = None) -> dict:
         if measured:
             prof["measured"] = measured
         out["profile"] = prof
+    if histograms:
+        from apex_tpu.monitor import spans as spans_mod
+        out["histograms"] = {k: spans_mod.hist_summary(histograms[k])
+                             for k in sorted(histograms)}
+    if span_ends or span_events:
+        per_name: dict[str, dict] = {}
+        for e in span_ends:
+            row = per_name.setdefault(e.get("name", ""),
+                                      {"n": 0, "total_s": 0.0})
+            row["n"] += 1
+            row["total_s"] = round(row["total_s"]
+                                   + float(e.get("value") or 0.0), 6)
+        for row in per_name.values():
+            row["mean_s"] = round(row["total_s"] / row["n"], 6) \
+                if row["n"] else 0.0
+        out["spans"] = {"by_name": {k: per_name[k]
+                                    for k in sorted(per_name)}}
+        if span_events:
+            out["spans"]["events"] = {k: span_events[k]
+                                      for k in sorted(span_events)}
+    serve = _serve_block(span_ends, histograms, gauges, gauge_series,
+                         counters)
+    if serve:
+        out["serve"] = serve
     if health:
         out["health"] = health
+    return out
+
+
+def _downsample(series: list, cap: int = 64) -> list:
+    if len(series) <= cap:
+        return [list(p) for p in series]
+    stride = len(series) / cap
+    picked = [series[int(i * stride)] for i in range(cap - 1)]
+    picked.append(series[-1])
+    return [list(p) for p in picked]
+
+
+def _serve_block(span_ends, histograms, gauges, gauge_series, counters):
+    """The request-level serve telemetry view: per-request table from
+    ``serve/request`` span ends, SLO percentiles from the streaming
+    histograms (``Recorder.observe``), pool-occupancy gauges, the
+    queue-depth trajectory, and the scheduler counters."""
+    requests = [e for e in span_ends if e.get("name") == "serve/request"]
+    serve_hists = {k: v for k, v in histograms.items()
+                   if k.startswith("serve/")}
+    serve_gauges = {k: v for k, v in gauges.items()
+                    if k.startswith("serve/")}
+    serve_counters = {k: v for k, v in counters.items()
+                      if k.startswith("serve/")}
+    if not (requests or serve_hists or serve_gauges or serve_counters):
+        return None
+    from apex_tpu.monitor import spans as spans_mod
+    out: dict = {}
+    if requests:
+        rows = []
+        for e in requests:
+            row = {"seq_id": e.get("seq_id"),
+                   "e2e_ms": round(1e3 * float(e.get("value") or 0.0), 3)}
+            for k in ("prompt_tokens", "new_tokens", "preemptions",
+                      "ttft_ms", "queue_wait_ms", "error"):
+                if e.get(k) is not None:
+                    row[k] = e[k]
+            rows.append(row)
+        rows.sort(key=lambda r: (r["seq_id"] is None, r["seq_id"]))
+        out["requests"] = rows
+    slo = {}
+    for key in ("token_latency_ms", "ttft_ms", "queue_wait_ms"):
+        snap = serve_hists.get(f"serve/{key}")
+        if snap:
+            slo[key] = spans_mod.hist_summary(snap, percentiles=(50, 95, 99))
+    if slo:
+        out["slo"] = slo
+    pool = {k[len("serve/"):]: serve_gauges[k] for k in
+            ("serve/pages_in_use", "serve/pages_free", "serve/pages_total",
+             "serve/pool_bytes_in_use") if k in serve_gauges}
+    if pool:
+        out["pool"] = pool
+    depth = gauge_series.get("serve/queue_depth")
+    if depth:
+        vals = [v for _, v in depth]
+        out["queue_depth"] = {"max": max(vals), "last": vals[-1],
+                              "trajectory": _downsample(depth)}
+    fill = gauge_series.get("serve/batch_fill")
+    if fill:
+        vals = [v for _, v in fill]
+        out["batch_fill_mean"] = round(sum(vals) / len(vals), 4)
+    if serve_counters:
+        out["counters"] = serve_counters
+    if "serve/goodput_tokens_per_sec_chip" in serve_gauges:
+        out["goodput_tokens_per_sec_chip"] = \
+            serve_gauges["serve/goodput_tokens_per_sec_chip"]
     return out
 
 
@@ -213,6 +324,70 @@ def render_steps(events: list[dict], max_rows: int = 50) -> str:
     return "\n".join(lines)
 
 
+def render_serve(agg: dict, max_rows: int = 50) -> Optional[str]:
+    """Render the ``serve`` block of an :func:`aggregate` result: SLO
+    percentiles (span-derived), pool occupancy, queue trajectory, and
+    the per-request span table. ``None`` when no serve telemetry was
+    recorded. Used by ``render_report`` and ``examples/serve_gpt.py
+    --monitor``."""
+    sv = agg.get("serve")
+    if not sv:
+        return None
+    parts = ["## serve (request-level telemetry)\n"]
+    if sv.get("goodput_tokens_per_sec_chip") is not None:
+        parts.append(f"goodput: "
+                     f"{_fmt(sv['goodput_tokens_per_sec_chip'])} "
+                     f"tokens/sec/chip")
+    slo = sv.get("slo") or {}
+    for key, label in (("token_latency_ms", "token latency"),
+                       ("ttft_ms", "time to first token"),
+                       ("queue_wait_ms", "queue wait")):
+        row = slo.get(key)
+        if row:
+            parts.append(
+                f"{label} ms: p50 {_fmt(row.get('p50'))}  "
+                f"p95 {_fmt(row.get('p95'))}  p99 {_fmt(row.get('p99'))}  "
+                f"(n={row.get('count')}, mean {_fmt(row.get('mean'))})")
+    pool = sv.get("pool") or {}
+    if pool:
+        total = pool.get("pages_total")
+        used = pool.get("pages_in_use")
+        pct = f" ({100.0 * used / total:.1f}%)" \
+            if total and used is not None else ""
+        nbytes = pool.get("pool_bytes_in_use")
+        tail = f", {_fmt(nbytes)} bytes" if nbytes is not None else ""
+        parts.append(f"pool: {used}/{total} pages in use{pct}{tail}")
+    qd = sv.get("queue_depth")
+    line = []
+    if qd:
+        line.append(f"queue depth: max {_fmt(qd['max'])} "
+                    f"last {_fmt(qd['last'])}")
+    if sv.get("batch_fill_mean") is not None:
+        line.append(f"batch fill mean {sv['batch_fill_mean']}")
+    pre = (sv.get("counters") or {}).get("serve/preemptions")
+    if pre is not None:
+        line.append(f"preemptions {_fmt(pre)}")
+    if line:
+        parts.append("; ".join(line))
+    reqs = sv.get("requests") or []
+    if reqs:
+        parts.append("")
+        parts.append("| request | prompt | new tokens | queue ms | "
+                     "ttft ms | e2e ms | preempts |\n"
+                     "|---|---|---|---|---|---|---|")
+        for r in reqs[:max_rows]:
+            parts.append(
+                f"| {r.get('seq_id')} | {r.get('prompt_tokens', '')} "
+                f"| {r.get('new_tokens', '')} "
+                f"| {_fmt(r.get('queue_wait_ms', ''))} "
+                f"| {_fmt(r.get('ttft_ms', ''))} "
+                f"| {_fmt(r.get('e2e_ms', ''))} "
+                f"| {r.get('preemptions', 0)} |")
+        if len(reqs) > max_rows:
+            parts.append(f"... ({len(reqs) - max_rows} more requests)")
+    return "\n".join(parts)
+
+
 def render_report(events: list[dict], header: Optional[dict] = None,
                   max_rows: int = 50) -> str:
     """Full human-readable report: per-step table + aggregates."""
@@ -230,6 +405,9 @@ def render_report(events: list[dict], header: Optional[dict] = None,
                 (f"rank {ev['rank']}" if ev.get("rank") is not None else "-")
             parts.append(f"- **{ev.get('name')}** [{ev.get('severity')}] "
                          f"({loc}): {ev.get('diagnosis')}")
+    serve = render_serve(agg, max_rows=max_rows)
+    if serve:
+        parts.append("\n" + serve)
     parts.append("\n## per-step\n")
     parts.append(render_steps(events, max_rows=max_rows))
     if "steps" in agg:

@@ -124,6 +124,13 @@ class CacheConfig:
         usable = max(0, self.pages_in_budget(budget_bytes) - 1)
         return usable // self.pages_for_tokens(seq_len)
 
+    def occupancy_bytes(self, pages_in_use: int) -> int:
+        """HBM bytes held by ``pages_in_use`` allocated pages — the
+        per-step ``serve/pool_bytes_in_use`` gauge the engine records
+        (same byte accounting as :meth:`bytes_per_page`, so the
+        telemetry and the capacity claims can never drift apart)."""
+        return int(pages_in_use) * self.bytes_per_page()
+
 
 class CacheState(NamedTuple):
     """The device pytree the jitted steps thread and donate."""

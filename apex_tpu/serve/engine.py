@@ -25,6 +25,18 @@ Tensor parallelism: with a model-parallel mesh installed
 (tp=1-layout) param tree and cache are split by the in_specs, the TP
 layers run their training collectives, and logits/next-token outputs
 come back replicated. The host-side scheduler is unchanged.
+
+Telemetry (``docs/serve.md`` / ``docs/observability.md``): with a
+recorder attached, every request gets a span trace — queue-wait →
+prefill (→ decode-replay on resume) → per-token decode — through
+:mod:`apex_tpu.monitor.spans`, token latency / TTFT / queue wait feed
+O(1)-memory streaming histograms, and each scheduler round records
+pool-occupancy + queue-depth gauges inside a per-step record (so the
+:class:`~apex_tpu.monitor.health.Watchdog`'s serve detectors observe
+them online). All host-clock, zero jax in the hot path: the compiled
+decode/prefill programs are byte-identical spans-on vs spans-off
+(asserted in ``tests/test_serve_telemetry.py``), and detached mode
+costs one global read per hook.
 """
 
 from __future__ import annotations
@@ -38,6 +50,9 @@ import numpy as np
 
 from apex_tpu._compat import shard_map
 from apex_tpu.models.gpt import GPTConfig
+from apex_tpu.monitor import _state as _monitor_state
+from apex_tpu.monitor import hooks as _mhooks
+from apex_tpu.monitor import spans as _mspans
 from apex_tpu.serve import cache as cache_mod
 from apex_tpu.serve import model as model_mod
 from apex_tpu.serve import rules as rules_mod
@@ -166,6 +181,14 @@ class ServeEngine:
                        max_new_tokens=max_new_tokens)
         self._next_id += 1
         self.seqs[seq.seq_id] = seq
+        # the request ROOT span: opened before the scheduler sees the
+        # sequence so the initial queue-wait span parents under it;
+        # closed when the last token samples (or never, if the caller
+        # abandons the engine — spans are host state, nothing leaks
+        # into compiled programs)
+        seq.span = _mspans.start("serve/request", seq_id=seq.seq_id,
+                                 prompt_tokens=len(seq.prompt),
+                                 max_new_tokens=max_new_tokens)
         self.sched.add(seq)
         return seq.seq_id
 
@@ -189,9 +212,25 @@ class ServeEngine:
     def _sample(self, seq: Sequence, token: int) -> None:
         seq.tokens.append(int(token))
         self.tokens_generated += 1
+        _mhooks.counter("serve/tokens_generated")
+        if seq.num_generated == 1 and seq.ttft_ms is None \
+                and seq.arrival_t:
+            # time-to-first-token, measured ONCE per request (a resumed
+            # sequence replays deterministically — its first token
+            # already happened)
+            seq.ttft_ms = 1e3 * (time.perf_counter() - seq.arrival_t)
+            _mhooks.observe("serve/ttft_ms", seq.ttft_ms)
         if seq.done:
             self.sched.finish(seq)
             self._free_slot(seq)
+            _mspans.end(seq.span, seq_id=seq.seq_id,
+                        prompt_tokens=len(seq.prompt),
+                        new_tokens=seq.num_generated,
+                        preemptions=seq.n_preemptions,
+                        ttft_ms=round(seq.ttft_ms, 3)
+                        if seq.ttft_ms is not None else None,
+                        queue_wait_ms=round(1e3 * seq.queue_wait_s, 3))
+            seq.span = None
 
     def _replay_generated(self, seq: Sequence) -> None:
         """Recompute the cache for a resumed sequence's generated
@@ -219,24 +258,41 @@ class ServeEngine:
         slot = self.slots.index(None)
         self.slots[slot] = seq
         seq.slot = slot
+        resumed = seq.num_generated > 0
         S = self.max_prompt_len
         ids = np.zeros((S,), np.int32)
         ids[:len(seq.prompt)] = seq.prompt
-        logits, next_tok, self.state = self._prefill(
-            self.params, self.state, jnp.asarray(self._bt_row(seq)),
-            jnp.int32(len(seq.prompt)), jnp.asarray(ids))
-        seq.num_cached = len(seq.prompt)
+        with _mspans.span("serve/prefill", parent=seq.span,
+                          seq_id=seq.seq_id, resumed=resumed,
+                          prompt_tokens=len(seq.prompt)):
+            logits, next_tok, self.state = self._prefill(
+                self.params, self.state, jnp.asarray(self._bt_row(seq)),
+                jnp.int32(len(seq.prompt)), jnp.asarray(ids))
+            seq.num_cached = len(seq.prompt)
+        _mhooks.counter("serve/prefills")
         self._record(seq, len(seq.prompt), logits)
-        if seq.num_generated == 0:
+        if not resumed:
             self._sample(seq, next_tok)
         else:
             # resumed: the generated tokens already exist; rebuild the
             # cache deterministically instead of re-sampling
-            self._replay_generated(seq)
+            with _mspans.span("serve/replay", parent=seq.span,
+                              seq_id=seq.seq_id,
+                              tokens=max(0, seq.num_generated - 1)):
+                self._replay_generated(seq)
 
     def step(self) -> bool:
         """One scheduler round: prefills + one batched decode. Returns
-        whether any work remains."""
+        whether any work remains. With a recorder attached the round
+        runs inside one per-step record (gauges/counters below land on
+        it, so the Watchdog's serve detectors see them online)."""
+        rec = _monitor_state.recorder
+        if rec is not None and rec._open_step is None:
+            with rec.step():
+                return self._step_inner()
+        return self._step_inner()
+
+    def _step_inner(self) -> bool:
         plan = self.sched.schedule()
         for seq in plan.preempted:
             self._free_slot(seq)
@@ -256,19 +312,52 @@ class ServeEngine:
                 act[slot] = True
                 bts[slot] = self._bt_row(seq)
             t0 = time.perf_counter()
-            logits, next_toks, self.state = self._decode(
-                self.params, self.state, jnp.asarray(bts),
-                jnp.asarray(pos), jnp.asarray(tok), jnp.asarray(act))
-            next_np = np.asarray(next_toks)
+            with _mspans.span("serve/decode_step",
+                              n_active=len(decodes)):
+                logits, next_toks, self.state = self._decode(
+                    self.params, self.state, jnp.asarray(bts),
+                    jnp.asarray(pos), jnp.asarray(tok), jnp.asarray(act))
+                next_np = np.asarray(next_toks)
             logits_np = np.asarray(logits) if self.record_logits else None
-            self.decode_step_times.append(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.decode_step_times.append(dt)
+            if _mhooks.enabled():
+                # per-TOKEN latency: each active slot produced one
+                # token this step — the streaming-percentile source of
+                # the serve SLO numbers (p50/p95/p99)
+                for _ in decodes:
+                    _mhooks.observe("serve/token_latency_ms", 1e3 * dt)
+                _mhooks.gauge("serve/batch_fill",
+                              len(decodes) / self.max_batch)
             for seq in decodes:
                 slot = seq.slot
                 seq.num_cached = seq.num_tokens
                 if logits_np is not None:
                     self._record(seq, seq.num_tokens, logits_np[slot])
                 self._sample(seq, next_np[slot])
+        self._record_step_gauges()
         return self.sched.has_work
+
+    def _record_step_gauges(self) -> None:
+        """Pool-occupancy + queue-state gauges, once per scheduler
+        round (the Watchdog's serve-side inputs). One `enabled` read
+        when detached."""
+        if not _mhooks.enabled():
+            return
+        alloc = self.sched.allocator
+        used = alloc.num_pages - 1 - alloc.free_pages
+        _mhooks.gauge("serve/pages_in_use", used)
+        _mhooks.gauge("serve/pages_free", alloc.free_pages)
+        _mhooks.gauge("serve/pages_total", alloc.num_pages - 1)
+        _mhooks.gauge("serve/pool_bytes_in_use",
+                      self.ccfg.occupancy_bytes(used))
+        _mhooks.gauge("serve/queue_depth", len(self.sched.waiting))
+        if self.sched.waiting:
+            oldest = min(s.queued_t for s in self.sched.waiting)
+            _mhooks.gauge("serve/queue_wait_oldest_s",
+                          max(0.0, time.perf_counter() - oldest))
+        else:
+            _mhooks.gauge("serve/queue_wait_oldest_s", 0.0)
 
     def preempt(self, seq_id: int) -> None:
         """Force-preempt a running sequence (tests/benchmarks; the
@@ -285,14 +374,58 @@ class ServeEngine:
         generated tokens for EVERY request ever added (including ones
         that already finished during earlier manual ``step()`` calls)."""
         steps = 0
+        t0 = time.perf_counter()
+        tok0 = self.tokens_generated
         while self.sched.has_work:
             self.step()
             steps += 1
             if steps > max_steps:
                 raise RuntimeError("serve engine did not drain "
                                    f"in {max_steps} steps")
+        self._record_run_summary(t0, tok0)
         return {sid: s.tokens[len(s.prompt):]
                 for sid, s in self.seqs.items()}
+
+    def _record_run_summary(self, t0: float, tok0: int) -> None:
+        """Goodput gauge + histogram-snapshot flush at drain time (one
+        `enabled` read when detached)."""
+        if not _mhooks.enabled():
+            return
+        dt = time.perf_counter() - t0
+        toks = self.tokens_generated - tok0
+        if dt > 0 and toks:
+            # tokens/s/chip goodput: completed-token throughput per
+            # participating chip (the serve twin of training MFU —
+            # monitor.profile.mfu)
+            _mhooks.gauge("serve/goodput_tokens_per_sec_chip",
+                          toks / dt / max(1, self.tp))
+        rec = _monitor_state.recorder
+        if rec is not None:
+            # cumulative SLO histograms ride the ring/stream, so a
+            # crash after drain still leaves the percentiles on disk
+            rec.emit_histograms()
+
+    def serve(self, *, export_port: Optional[int] = None,
+              export_addr: str = "127.0.0.1",
+              max_steps: int = 100_000) -> Dict[int, List[int]]:
+        """:meth:`run` with a live metrics surface: when
+        ``export_port`` is given, a :class:`~apex_tpu.monitor.export.
+        MetricsExporter` serves ``GET /metrics`` (Prometheus text
+        exposition of the attached recorder's counters/gauges/SLO
+        histograms) for the duration of the drain — ``export_port=0``
+        binds an ephemeral port (``self.export_port`` holds the bound
+        port). Without ``export_port`` this IS ``run()`` — no thread,
+        no ``http.server`` import."""
+        if export_port is None:
+            return self.run(max_steps=max_steps)
+        from apex_tpu.monitor import export as export_mod
+        exporter = export_mod.MetricsExporter(port=export_port,
+                                              addr=export_addr)
+        self.export_port = exporter.start()
+        try:
+            return self.run(max_steps=max_steps)
+        finally:
+            exporter.stop()
 
 
 def naive_generate(cfg: GPTConfig, params, requests, *, max_seq_len: int,

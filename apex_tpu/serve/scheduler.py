@@ -21,12 +21,26 @@ stay pure. The policy is the vLLM recompute-preemption shape:
 
 Page 0 of the pool is the null page and is never allocated (the
 ``cache`` module's masked-write convention).
+
+Telemetry: every scheduling transition is traced through
+:mod:`apex_tpu.monitor.spans` and the host hooks — a ``serve/queue_wait``
+span opens when a sequence enters (or re-enters, after preemption) the
+waiting queue and closes at admission, preemptions emit a
+``serve/preempt`` annotation + counter, and the measured queue wait
+feeds the ``serve/queue_wait_ms`` streaming histogram. All of it is
+host-clock-only and detached-free (``apex_tpu.monitor`` is zero-dep —
+this module still imports no jax, and with no recorder attached every
+hook is one global read).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import List, Optional
+
+from apex_tpu.monitor import hooks as _mhooks
+from apex_tpu.monitor import spans as _mspans
 
 WAITING = "waiting"
 RUNNING = "running"
@@ -47,6 +61,13 @@ class Sequence:
     slot: Optional[int] = None         # engine batch slot while RUNNING
     num_cached: int = 0                # positions with K/V in the pool
     n_preemptions: int = 0
+    # -- telemetry (host-only; None/0 when monitoring is detached) ----
+    span: Optional[int] = None         # serve/request span id
+    queue_span: Optional[int] = None   # open serve/queue_wait span id
+    arrival_t: float = 0.0             # perf_counter at first add()
+    queued_t: float = 0.0              # perf_counter at last (re)queue
+    queue_wait_s: float = 0.0          # total time spent WAITING
+    ttft_ms: Optional[float] = None    # arrival -> first generated token
 
     def __post_init__(self):
         if not self.prompt:
@@ -120,6 +141,12 @@ class Scheduler:
         seq.arrival = self._arrival
         self._arrival += 1
         seq.state = WAITING
+        now = time.perf_counter()
+        seq.arrival_t = seq.arrival_t or now
+        seq.queued_t = now
+        seq.queue_span = _mspans.start(
+            "serve/queue_wait", parent=seq.span, seq_id=seq.seq_id)
+        _mhooks.counter("serve/requests_queued")
         self.waiting.append(seq)
 
     def finish(self, seq: Sequence) -> None:
@@ -129,6 +156,7 @@ class Scheduler:
         seq.pages = []
         seq.slot = None
         seq.num_cached = 0
+        _mhooks.counter("serve/requests_finished")
 
     @property
     def has_work(self) -> bool:
@@ -140,11 +168,25 @@ class Scheduler:
     def _preempt(self, seq: Sequence) -> None:
         seq.state = WAITING
         seq.n_preemptions += 1
+        freed = len(seq.pages)
         self.running.remove(seq)
         self.allocator.free(seq.pages)
         seq.pages = []
         seq.slot = None
         seq.num_cached = 0
+        # evict/re-queue transition on the request trace: annotation on
+        # the request span + a fresh queue-wait span (re-admission will
+        # close it and add the second wait to the request's total)
+        _mhooks.counter("serve/preemptions")
+        _mspans.annotate("serve/preempt", span=seq.span,
+                         seq_id=seq.seq_id,
+                         n_preemptions=seq.n_preemptions,
+                         freed_pages=freed,
+                         tokens_kept=seq.num_tokens)
+        seq.queued_t = time.perf_counter()
+        seq.queue_span = _mspans.start(
+            "serve/queue_wait", parent=seq.span, seq_id=seq.seq_id,
+            resumed=True)
         # back of the ARRIVAL order, front of readmission among later
         # arrivals: waiting stays sorted by arrival
         self.waiting.append(seq)
@@ -196,6 +238,16 @@ class Scheduler:
             self.waiting.pop(0)
             seq.pages = got
             seq.state = RUNNING
+            # admission closes the open queue-wait span; the measured
+            # wait (wall clock, span or not) feeds the streaming
+            # histogram and the request's running total
+            wait_s = time.perf_counter() - seq.queued_t \
+                if seq.queued_t else 0.0
+            seq.queue_wait_s += wait_s
+            _mspans.end(seq.queue_span, seq_id=seq.seq_id)
+            seq.queue_span = None
+            _mhooks.observe("serve/queue_wait_ms", 1e3 * wait_s)
+            _mhooks.counter("serve/admissions")
             self.running.append(seq)
             plan.prefill.append(seq)
         return plan

@@ -1952,15 +1952,27 @@ def _bench_profile():
         f"scoped-FLOPs coverage {cov:.3f} < 0.9 — a hot path lost its " \
         f"profile scope (unscoped row: {prof['unscoped']})"
     top = sorted(prof["scopes"].items(), key=lambda kv: -kv[1]["flops"])
-    return {"profile_flops_scope_coverage": round(cov, 4),
-            "profile_total_flops": int(prof["total"]["flops"]),
-            "profile_total_hbm_bytes": int(prof["total"]["hbm_bytes"]),
-            "profile_n_scopes": len(prof["scopes"]),
-            "profile_top_scopes": [
-                {"scope": name, "flops": int(row["flops"]),
-                 "pct": round(100.0 * row["flops"]
-                              / max(prof["total"]["flops"], 1), 1)}
-                for name, row in top[:6]]}
+    out = {"profile_flops_scope_coverage": round(cov, 4),
+           "profile_total_flops": int(prof["total"]["flops"]),
+           "profile_total_hbm_bytes": int(prof["total"]["hbm_bytes"]),
+           "profile_n_scopes": len(prof["scopes"]),
+           "profile_top_scopes": [
+               {"scope": name, "flops": int(row["flops"]),
+                "pct": round(100.0 * row["flops"]
+                             / max(prof["total"]["flops"], 1), 1)}
+               for name, row in top[:6]]}
+    # MFU: the analytic walk priced the step; divide by measured wall
+    # and the per-device_kind peak table (monitor.profile.PEAK_FLOPS —
+    # the cpu row is a NOMINAL table figure, and the platform-bound
+    # unit stamp keeps cross-host rounds incomparable by construction)
+    mrow = prof_mod.measured_mfu(step, step_args,
+                                 flops=prof["total"]["flops"], repeats=3)
+    if mrow is not None:
+        out["profile_step_time_ms"] = round(1e3 * mrow["step_time_s"], 3)
+        if mrow.get("mfu_pct") is not None:
+            out["profile_mfu_pct"] = mrow["mfu_pct"]
+            out["profile_device_kind"] = str(mrow.get("device_kind"))
+    return out
 
 
 def _bench_serve_decode():
@@ -1978,10 +1990,19 @@ def _bench_serve_decode():
     - fp8-KV fits >= 2x the concurrent sequences of bf16 at the SAME
       pool bytes, from ``CacheConfig`` byte accounting (e4m3 pages +
       per-page scales vs bf16 pages), not a hand-waved 2x.
+
+    SLO methodology (this round on): p50/p99 token latency, TTFT and
+    queue wait come FROM the span/histogram layer (``monitor.spans``
+    via a host-only observer recorder attached for the steady-state
+    drive) — the same numbers a live ``monitor export`` scrape serves
+    — not from ad-hoc list timing. Compile exclusion: the recorder
+    attaches AFTER the two warmup steps, and the last two requests are
+    added inside the attached window so their arrival -> first-token
+    spans never cross a compile.
     """
     import numpy as np
     import jax.numpy as jnp
-    from apex_tpu import serve
+    from apex_tpu import monitor, serve
     from apex_tpu.models.gpt import GPT, GPTConfig
     import jax as _jax
 
@@ -1999,22 +2020,30 @@ def _bench_serve_decode():
 
     eng = serve.ServeEngine(cfg, params, num_pages=64, max_seq_len=max_seq,
                             max_prompt_len=32, max_batch=max_batch)
-    for prompt, n_new in requests:
+    for prompt, n_new in requests[:4]:
         eng.add_request(prompt, n_new)
     eng.step()                      # compiles prefill (admission round)
     eng.step()                      # compiles decode (first batch step)
     pre_tokens = eng.tokens_generated
-    pre_steps = len(eng.decode_step_times)
-    t0 = time.perf_counter()
-    eng.run()
-    paged_s = time.perf_counter() - t0
+    srec = monitor.Recorder(traced_hooks=False, name="serve_bench")
+    with monitor.attached(srec):
+        for prompt, n_new in requests[4:]:
+            eng.add_request(prompt, n_new)   # clean arrival clocks
+        t0 = time.perf_counter()
+        eng.run()
+        paged_s = time.perf_counter() - t0
     n_tokens = eng.tokens_generated - pre_tokens
     paged_tps = n_tokens / paged_s
-    lat_ms = sorted(dt * 1e3 for dt in eng.decode_step_times[pre_steps:])
-
-    def pct(p):
-        return lat_ms[min(len(lat_ms) - 1,
-                          int(round(p / 100 * (len(lat_ms) - 1))))]
+    sagg = srec.aggregate()
+    sv = sagg.get("serve") or {}
+    slo = sv.get("slo") or {}
+    lat = slo.get("token_latency_ms") or {}
+    ttft = slo.get("ttft_ms") or {}
+    qwait = slo.get("queue_wait_ms") or {}
+    assert lat.get("count"), \
+        "span layer recorded no token latencies — serve telemetry lost"
+    assert ttft.get("count"), \
+        "span layer recorded no TTFT — serve telemetry lost"
 
     # the naive baseline: same greedy decode, NO cache — every token
     # re-runs the full padded-context forward. It gets the WHOLE
@@ -2062,21 +2091,34 @@ def _bench_serve_decode():
     engf.run()
     fp8_s = time.perf_counter() - t0
 
-    return {"serve_decode_tokens_per_sec": round(paged_tps, 1),
-            "serve_naive_tokens_per_sec": round(naive_tps, 1),
-            "serve_decode_speedup_vs_naive": round(speedup, 2),
-            "serve_decode_p50_token_ms": round(pct(50), 3),
-            "serve_decode_p99_token_ms": round(pct(99), 3),
-            "serve_decode_steps": len(eng.decode_step_times),
-            "serve_requests": len(requests),
-            "serve_tokens_generated": n_tokens,
-            "serve_page_size": eng.ccfg.page_size,
-            "serve_paged_impl": eng.paged_impl,
-            "serve_fp8_capacity_ratio": round(cap_ratio, 2),
-            "serve_fp8_seqs_at_budget": seqs_fp8,
-            "serve_bf16_seqs_at_budget": seqs_bf16,
-            "serve_fp8_tokens_per_sec":
-                round((engf.tokens_generated - fp8_pre) / fp8_s, 1)}
+    out = {"serve_decode_tokens_per_sec": round(paged_tps, 1),
+           "serve_naive_tokens_per_sec": round(naive_tps, 1),
+           "serve_decode_speedup_vs_naive": round(speedup, 2),
+           # span-derived SLO keys (monitor.spans histograms; the
+           # `monitor regress` direction table knows them all)
+           "serve_p50_token_ms": round(lat["p50"], 3),
+           "serve_p99_token_ms": round(lat["p99"], 3),
+           # legacy key names kept, now sourced from the SAME span
+           # layer (acceptance: no ad-hoc timing path remains)
+           "serve_decode_p50_token_ms": round(lat["p50"], 3),
+           "serve_decode_p99_token_ms": round(lat["p99"], 3),
+           "serve_ttft_ms": round(ttft["p50"], 3),
+           "serve_decode_steps": len(eng.decode_step_times),
+           "serve_requests": len(requests),
+           "serve_tokens_generated": n_tokens,
+           "serve_page_size": eng.ccfg.page_size,
+           "serve_paged_impl": eng.paged_impl,
+           "serve_fp8_capacity_ratio": round(cap_ratio, 2),
+           "serve_fp8_seqs_at_budget": seqs_fp8,
+           "serve_bf16_seqs_at_budget": seqs_bf16,
+           "serve_fp8_tokens_per_sec":
+               round((engf.tokens_generated - fp8_pre) / fp8_s, 1)}
+    if qwait.get("count"):
+        out["serve_queue_wait_ms"] = round(qwait["p50"], 3)
+    good = sv.get("goodput_tokens_per_sec_chip")
+    if good is not None:
+        out["serve_goodput_tokens_per_sec_chip"] = round(good, 1)
+    return out
 
 
 def _bench_gpt_moe():
@@ -2296,6 +2338,20 @@ _METRIC_UNITS = {
         "ratio (paged cache vs full-recompute, same chip)",
     "serve_fp8_capacity_ratio":
         "ratio (fp8-KV vs bf16-KV concurrent seqs, same pool bytes)",
+    # span-derived serve SLO keys (r14 on: sourced from the
+    # monitor.spans histogram layer, not ad-hoc timing lists) + the
+    # MFU/goodput accounting — registered here so `monitor regress`
+    # gates them with known units/directions instead of reading them
+    # as unknown-direction blanks
+    "serve_p50_token_ms": "ms (per generated token, span-derived)",
+    "serve_p99_token_ms": "ms (per generated token, span-derived)",
+    "serve_decode_p50_token_ms": "ms (per generated token, span-derived)",
+    "serve_decode_p99_token_ms": "ms (per generated token, span-derived)",
+    "serve_ttft_ms": "ms (arrival -> first token, span-derived)",
+    "serve_queue_wait_ms": "ms (admission wait, span-derived)",
+    "serve_goodput_tokens_per_sec_chip": "tokens/sec/chip (goodput)",
+    "profile_mfu_pct": "% of device_kind peak FLOPs (profile table)",
+    "profile_step_time_ms": "ms",
     # the r13 kernel sections (fused_ln / multi_tensor_update): the
     # cost-model numbers are platform-INDEPENDENT (deterministic fake
     # clock) so they form cross-round priors for monitor.regress even

@@ -5,7 +5,17 @@ scheduler, greedy decode — plus the fp8-KV capacity accounting and the
 naive full-recompute comparison. Runs anywhere (CPU included: the
 engine picks the XLA reference attention paths off-TPU).
 
-    python examples/serve_gpt.py [--fp8-kv] [--requests 6] [--steps]
+    python examples/serve_gpt.py [--fp8-kv] [--requests 6]
+        [--monitor [RUN.jsonl]] [--export-port N]
+
+``--monitor`` attaches a host-only observer Recorder (the
+``main_amp.py`` precedent) and prints the request-level telemetry at
+exit: the per-request span table (queue wait / TTFT / e2e / preempts),
+the span-derived SLO percentiles, and the page-pool occupancy summary;
+an optional path also dumps the raw event JSONL for
+``python -m apex_tpu.monitor report``. ``--export-port`` additionally
+serves live Prometheus text exposition at ``/metrics`` while the
+engine drains (``ServeEngine.serve``).
 """
 
 import argparse
@@ -22,11 +32,22 @@ def main():
                    help="store the KV cache as e4m3 pages (amp.fp8 codec)")
     p.add_argument("--compare-naive", action="store_true",
                    help="also run the no-cache full-recompute baseline")
+    p.add_argument("--monitor", nargs="?", const="", default=None,
+                   metavar="RUN.jsonl",
+                   help="attach a Recorder; print the per-request span "
+                        "table + pool-occupancy summary at exit "
+                        "(optional arg: also dump the event JSONL)")
+    p.add_argument("--export-port", type=int, default=None,
+                   help="serve live /metrics (Prometheus text "
+                        "exposition) on this port while draining "
+                        "(0 = ephemeral; implies --monitor)")
     args = p.parse_args()
+
+    import contextlib
 
     import jax
     import jax.numpy as jnp
-    from apex_tpu import serve
+    from apex_tpu import monitor, serve
     from apex_tpu.models.gpt import GPT, GPTConfig
 
     cfg = GPTConfig(vocab_size=128, max_seq_len=128, hidden_size=64,
@@ -37,17 +58,23 @@ def main():
     engine = serve.ServeEngine(cfg, params, num_pages=64, max_seq_len=64,
                                max_prompt_len=32, max_batch=4,
                                fp8_kv=args.fp8_kv)
-    rng = np.random.RandomState(0)
-    prompts = {}
-    for _ in range(args.requests):
-        prompt = list(rng.randint(0, cfg.vocab_size,
-                                  int(rng.randint(4, 16))))
-        rid = engine.add_request(prompt, args.max_new_tokens)
-        prompts[rid] = prompt
+    monitoring = args.monitor is not None or args.export_port is not None
+    rec = monitor.Recorder(traced_hooks=False, name="serve_gpt") \
+        if monitoring else None
+    ctx = monitor.attached(rec) if rec is not None \
+        else contextlib.nullcontext()
+    with ctx:
+        rng = np.random.RandomState(0)
+        prompts = {}
+        for _ in range(args.requests):
+            prompt = list(rng.randint(0, cfg.vocab_size,
+                                      int(rng.randint(4, 16))))
+            rid = engine.add_request(prompt, args.max_new_tokens)
+            prompts[rid] = prompt
 
-    t0 = time.perf_counter()
-    outputs = engine.run()
-    dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        outputs = engine.serve(export_port=args.export_port)
+        dt = time.perf_counter() - t0
     for rid in sorted(outputs):
         print(f"request {rid}: prompt[{len(prompts[rid])}] -> "
               f"{outputs[rid]}")
@@ -86,6 +113,20 @@ def main():
             assert naive_out == [outputs[r] for r in sorted(outputs)], \
                 "paged and naive greedy decode disagree"
             print("paged == naive greedy decode: ok")
+
+    if rec is not None:
+        print("\nserve telemetry (request-level spans + SLO histograms):")
+        agg = rec.aggregate()
+        rendered = monitor.render_serve(agg)
+        print(rendered if rendered else "(no serve telemetry recorded)")
+        if args.export_port is not None:
+            print(f"(live /metrics was served on port "
+                  f"{engine.export_port} during the drain)")
+        if args.monitor:
+            n = rec.dump_jsonl(args.monitor)
+            print(f"dumped {n} events to {args.monitor} "
+                  f"(render: python -m apex_tpu.monitor report "
+                  f"{args.monitor})")
     print("serve ok")
 
 

@@ -32,9 +32,16 @@
 #                    timed-out one) landed in the flushed JSONL — the
 #                    guard against a repeat of the r5 evidence loss
 #                    (BENCH_r05.json: rc=124, parsed: null)
+#   4b. export     — python -m apex_tpu.monitor export --once --check:
+#                    the smoke-bench recorder stream must render as
+#                    valid Prometheus text exposition AND parse back to
+#                    the same values (the scrape == aggregate
+#                    self-check); plus `monitor profile --model gpt`
+#                    must report an MFU line from the per-device_kind
+#                    peak table
 #   5. regress     — python -m apex_tpu.monitor regress: the smoke
 #                    stream must load as an evidence round, and the
-#                    committed BENCH_r01-r07 rounds must degrade exactly
+#                    committed BENCH_r01-r08 rounds must degrade exactly
 #                    as documented (r05 no-evidence, r01 incomparable,
 #                    cpu-host rounds unit-marked) with no false
 #                    regression verdict
@@ -107,11 +114,41 @@ missing = {"tp_overlap", "ddp_bucket_overlap", "pp_zero_bubble",
 if missing:
     print(f"ci: sections missing from bench stream: {sorted(missing)}")
     raise SystemExit(1)
+# the serve section's SLO numbers must now be SPAN-derived: the
+# stream line carries the monitor.spans histogram keys, not just the
+# legacy ad-hoc ones (acceptance criterion of the telemetry PR)
+serve = next(ev.get("data") or {} for ev in
+             map(json.loads, open(sys.argv[1]))
+             if ev.get("kind") == "section"
+             and ev.get("name") == "serve_decode")
+span_keys = {"serve_p50_token_ms", "serve_p99_token_ms",
+             "serve_ttft_ms"}
+missing_slo = span_keys - set(serve)
+if missing_slo and not any(k.endswith(("_error", "_skipped"))
+                           for k in serve):
+    print(f"ci: serve section lost span-derived SLO keys: "
+          f"{sorted(missing_slo)} (have: {sorted(serve)[:20]})")
+    raise SystemExit(1)
 print("ci: tp_overlap + ddp_bucket_overlap + pp_zero_bubble + "
       "zero_sharded_step + fp8_step + autotune + fused_ln + "
       "multi_tensor_update + profile + serve_decode "
-      "present in bench stream")
+      "present in bench stream (serve SLO keys span-derived)")
 EOF
+
+echo "== ci: monitor export (Prometheus exposition) + profile MFU =="
+# the smoke-bench recorder stream must render as valid exposition and
+# round-trip (scrape -> parse -> values == aggregate): --check raises
+# on any drift
+python -m apex_tpu.monitor export /tmp/ci_bench_smoke_stream.jsonl \
+    --once --check > /tmp/ci_export.txt || fail=1
+grep -q "^apex_" /tmp/ci_export.txt || {
+  echo "ci: export emitted no apex_ metrics"; fail=1; }
+# the profile CLI reports MFU beside the FLOPs table (tiny default
+# shapes; the cpu peak-table row makes the line concrete on CI hosts)
+JAX_PLATFORMS=cpu python -m apex_tpu.monitor profile --model gpt \
+    > /tmp/ci_profile_mfu.txt || fail=1
+grep -q "^MFU: " /tmp/ci_profile_mfu.txt || {
+  echo "ci: monitor profile lost its MFU line"; fail=1; }
 
 echo "== ci: bench-trajectory regression gate (monitor.regress) =="
 # 1) the smoke stream must load as an evidence round without crashing
@@ -119,16 +156,16 @@ echo "== ci: bench-trajectory regression gate (monitor.regress) =="
 #    are exercised on every CI run)
 python -m apex_tpu.monitor regress /tmp/ci_bench_smoke_stream.jsonl \
     --json > /tmp/ci_regress_smoke.json || fail=1
-# 2) the committed rounds r01-r07 must degrade exactly as documented:
+# 2) the committed rounds r01-r08 must degrade exactly as documented:
 #    r05 is a no-evidence row (rc=124), r01 is incomparable with r02+
-#    (the unit-methodology change), the cpu-host rounds (r06/r07) are
+#    (the unit-methodology change), the cpu-host rounds (r06-r08) are
 #    unit-marked so platform-bound metrics never cross-compare, and no
 #    false regression fires
 python - <<'EOF' || fail=1
 import json, subprocess, sys
 p = subprocess.run(
     [sys.executable, "-m", "apex_tpu.monitor", "regress",
-     *[f"BENCH_r0{i}.json" for i in range(1, 8)], "--json"],
+     *[f"BENCH_r0{i}.json" for i in range(1, 9)], "--json"],
     capture_output=True, text=True)
 if p.returncode != 0:
     print(f"ci: regress over committed rounds exited {p.returncode}:\n"
@@ -137,7 +174,7 @@ if p.returncode != 0:
 rep = json.loads(p.stdout)
 by = {r["round"]: r for r in rep["rounds"]}
 assert by["r05"]["status"] == "no-evidence", by["r05"]
-assert by["r07"]["status"] == "ok", by["r07"]
+assert by["r08"]["status"] == "ok", by["r08"]
 inc = rep["metrics"]["value"].get("incomparable") or []
 assert any(i["round"] == "r01" for i in inc), rep["metrics"]["value"]
 # the r13 kernel cost-model keys are platform-independent: they must be
@@ -146,10 +183,21 @@ units = {k: rep["metrics"][k]["unit"] for k in rep["metrics"]
          if k.startswith(("fused_ln_", "fused_ce_", "multi_tensor_"))}
 missing = [k for k, u in units.items() if not u]
 assert not missing, f"unregistered kernel metric units: {missing}"
+# the r14 serve SLO / MFU keys must be unit-registered with a known
+# gating direction (the regress direction table satellite)
+from apex_tpu.monitor.regress import metric_direction
+for k in [m for m in rep["metrics"]
+          if m.startswith(("serve_ttft", "serve_p50", "serve_p99",
+                           "serve_queue_wait", "serve_goodput"))
+          or m == "profile_mfu_pct"]:
+    u = rep["metrics"][k]["unit"]
+    assert u, f"unregistered serve/MFU metric unit: {k}"
+    assert metric_direction(k, u) is not None, \
+        f"no gating direction for {k} ({u})"
 assert not rep["regressions"], rep["regressions"]
-print("ci: regress gate ok over r01-r07 (r05 no-evidence, r01 "
-      "incomparable, kernel metric units registered, no false "
-      "regressions)")
+print("ci: regress gate ok over r01-r08 (r05 no-evidence, r01 "
+      "incomparable, kernel + serve-SLO/MFU metric units registered, "
+      "no false regressions)")
 EOF
 
 if [[ "$fail" == "0" ]]; then

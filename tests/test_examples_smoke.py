@@ -31,6 +31,35 @@ def test_serve_gpt_example_smoke():
     assert "fp8-KV capacity" in proc.stdout, proc.stdout[-2000:]
 
 
+def test_serve_gpt_example_monitor_flag(tmp_path):
+    """examples/serve_gpt.py --monitor: attaches a Recorder and prints
+    the request-level span table + pool-occupancy summary at exit (the
+    main_amp.py precedent); the optional path dumps a JSONL that the
+    monitor report CLI can render."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    run_jsonl = str(tmp_path / "serve_run.jsonl")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "serve_gpt.py"),
+         "--requests", "3", "--max-new-tokens", "6",
+         "--monitor", run_jsonl],
+        env=env, capture_output=True, text=True, timeout=280)
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-3000:]
+    assert "serve ok" in proc.stdout, proc.stdout[-2000:]
+    assert "serve telemetry" in proc.stdout, proc.stdout[-2000:]
+    assert "| request |" in proc.stdout, proc.stdout[-2000:]
+    assert "pool:" in proc.stdout, proc.stdout[-2000:]
+    assert "token latency ms: p50" in proc.stdout, proc.stdout[-2000:]
+    assert os.path.exists(run_jsonl)
+    # the dump renders through the report CLI with the serve block
+    proc2 = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.monitor", "report", run_jsonl],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc2.returncode == 0, (proc2.stdout + proc2.stderr)[-2000:]
+    assert "## serve (request-level telemetry)" in proc2.stdout
+
+
 def test_simple_amp_example_converges_at_defaults(tmp_path):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
