@@ -475,6 +475,34 @@ def _profiled_train_step():
     return fn, args, allowed
 
 
+def _memory_profiled_step():
+    """The amp train step traced while the FULL memory instrumentation
+    is armed: recorder attached, a live :class:`MemorySampler` thread
+    polling, and the analytic high-water walk running over the very
+    step being gated. Keeps the memory layer's purity contract inside
+    the zero-findings gate — a sampler that inserted ops, a snapshot
+    that did jax work at import (APX001), or a walk that left side
+    effects under jit (APX005) would be caught here. Jitted with the
+    explicit APX007 opt-out: this entrypoint is only traced abstractly
+    and its toy inputs double as the checker's returned values."""
+    import jax
+    from apex_tpu import monitor
+    from apex_tpu.monitor import memory as memory_mod
+
+    step, args, allowed = _amp_train_step()
+    rec = monitor.Recorder(name="lint-memory-entrypoint")
+    sampler = memory_mod.MemorySampler(0.05, recorder=rec)
+
+    def sampled(*a):
+        with monitor.attached(rec), sampler:
+            memory_mod.analytic_high_water(
+                lambda *aa: step._jitted(True, *aa), *a)
+            return step._jitted(True, *a)
+
+    fn = jax.jit(sampled, donate_argnums=())
+    return fn, args, allowed
+
+
 def _serve_decode_step():
     """The serve decode step under tp=2: one token per batch slot
     through the TP layers with the paged KV cache sharded along heads
@@ -722,6 +750,7 @@ register_entrypoint("flash_attention_tuned_step", _flash_attention_tuned_step)
 register_entrypoint("fused_layer_norm_step", _fused_layer_norm_step)
 register_entrypoint("zero_fused_update_step", _zero_fused_update_step)
 register_entrypoint("profiled_train_step", _profiled_train_step)
+register_entrypoint("memory_profiled_step", _memory_profiled_step)
 register_entrypoint("serve_decode_step", _serve_decode_step)
 register_entrypoint("serve_prefill_step", _serve_prefill_step)
 register_entrypoint("fused_lm_head_ce", _fused_lm_head_ce)

@@ -23,7 +23,12 @@ traces with preempt/re-admit annotations, rendered as the ``serve``
 block of the report), a pull-based Prometheus text-exposition endpoint
 (``monitor.export``: lazily imported, ``python -m apex_tpu.monitor
 export``), MFU/goodput accounting (``monitor.profile.mfu`` over the
-analytic FLOPs walk + a per-device-kind peak table), and a CLI report
+analytic FLOPs walk + a per-device-kind peak table), the unified
+memory surface (``monitor.memory``: compiled-footprint attribution,
+the analytic high-water walk charged per ``apx:`` scope, the live
+:class:`MemorySampler` HBM timeline, ZeRO/serve capacity reports and
+the tuner's ``vmem_calibration`` feedback loop,
+``python -m apex_tpu.monitor memory``), and a CLI report
 (``python -m apex_tpu.monitor report run.jsonl``).
 
 Quick start::
@@ -60,6 +65,7 @@ import contextlib
 from apex_tpu.monitor import _state
 from apex_tpu.monitor import health  # noqa: F401
 from apex_tpu.monitor import hooks  # noqa: F401
+from apex_tpu.monitor import memory  # noqa: F401
 from apex_tpu.monitor import merge  # noqa: F401
 from apex_tpu.monitor import profile  # noqa: F401
 from apex_tpu.monitor import regress  # noqa: F401
@@ -67,11 +73,12 @@ from apex_tpu.monitor import spans  # noqa: F401
 from apex_tpu.monitor import trace  # noqa: F401
 from apex_tpu.monitor import xprof  # noqa: F401
 from apex_tpu.monitor.health import Watchdog  # noqa: F401
+from apex_tpu.monitor.memory import MemorySampler  # noqa: F401
 from apex_tpu.monitor.profile import scope  # noqa: F401
 from apex_tpu.monitor.recorder import Recorder  # noqa: F401
 from apex_tpu.monitor.report import (  # noqa: F401
-    aggregate, load_jsonl, render_cross_host, render_report, render_serve,
-    render_steps, selfcheck)
+    aggregate, load_jsonl, render_cross_host, render_memory, render_report,
+    render_serve, render_steps, selfcheck)
 from apex_tpu.monitor.spans import LogHistogram  # noqa: F401
 from apex_tpu.monitor.hooks import enabled, epoch  # noqa: F401
 

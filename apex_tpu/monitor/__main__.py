@@ -3,6 +3,8 @@
     python -m apex_tpu.monitor report run.jsonl [--json] [--max-rows N]
     python -m apex_tpu.monitor merge SHARD... [--json] [-o OUT.json]
     python -m apex_tpu.monitor profile [--model gpt|mlp] [--measured]
+    python -m apex_tpu.monitor memory [--model gpt|mlp|zero|serve]
+                                      [--live] [--json]
     python -m apex_tpu.monitor regress RUNS... [--against BASELINE.json]
     python -m apex_tpu.monitor export run.jsonl [--once [--check]|--port N]
     python -m apex_tpu.monitor selfcheck [--steps N]
@@ -34,6 +36,17 @@ amp run on CPU and asserts the dump → report round trip (used by
 analytic step FLOPs divided by measured wall time and the
 per-``device_kind`` peak-FLOPs table (``--peak-tflops`` overrides the
 table; ``--no-mfu`` skips the timed execution).
+
+``memory`` is the unified byte view (``monitor.memory``): for
+``--model gpt|mlp`` it prints the compiled footprint
+(``Compiled.memory_analysis``) and the analytic high-water walk's
+per-scope peak table for the canonical train step (the ``profile``
+recipe), plus the ``vmem_calibration`` tuner feedback rows;
+``--live`` additionally runs the step under a :class:`MemorySampler`
+and reports the HBM timeline. ``--model zero`` prints the ZeRO
+dense/zero2/zero3 per-chip residency split measured through
+``memory.resident_bytes`` (the PR 6 ratio, re-derived live);
+``--model serve`` prints the KV-pool occupancy/capacity accounting.
 """
 
 from __future__ import annotations
@@ -105,6 +118,25 @@ def main(argv=None) -> int:
                          "table in monitor.profile)")
     pp.add_argument("--no-mfu", action="store_true",
                     help="skip the timed step execution + MFU line")
+
+    pmem = sub.add_parser("memory",
+                          help="unified memory view: compiled "
+                               "footprint + analytic high water per "
+                               "scope (+ZeRO/serve capacity reports)")
+    pmem.add_argument("--model", choices=("gpt", "mlp", "zero", "serve"),
+                      default="gpt")
+    pmem.add_argument("--live", action="store_true",
+                      help="also execute the step under a "
+                           "MemorySampler and report the HBM timeline "
+                           "(gpt/mlp models)")
+    pmem.add_argument("--steps", type=int, default=3,
+                      help="steps to execute under --live")
+    pmem.add_argument("--interval", type=float, default=0.05,
+                      help="sampler interval seconds for --live")
+    pmem.add_argument("--no-calibration", action="store_true",
+                      help="skip the tune/vmem calibration rows")
+    pmem.add_argument("--json", action="store_true")
+    pmem.add_argument("--max-rows", type=int, default=30)
 
     pg = sub.add_parser("regress",
                         help="bench-trajectory verdicts over evidence "
@@ -194,6 +226,9 @@ def main(argv=None) -> int:
     if args.cmd == "profile":
         return _run_profile(args)
 
+    if args.cmd == "memory":
+        return _run_memory(args)
+
     # selfcheck needs a backend; default to CPU unless the caller chose
     import os
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -237,6 +272,89 @@ def _run_profile(args) -> int:
         # human-readable per-op table moves to stderr
         _profile_per_op(step, step_args,
                         out=sys.stderr if args.json else sys.stdout)
+    return 0
+
+
+def _run_memory(args) -> int:
+    from apex_tpu import monitor
+    from apex_tpu.monitor import memory as memory_mod
+    from apex_tpu.monitor import profile as profile_mod
+    from apex_tpu.monitor.recorder import json_safe
+
+    out: dict = {"model": args.model}
+    rendered: list = []
+    if args.model == "zero":
+        out["zero"] = memory_mod.zero_memory_report()
+        pc = out["zero"]["per_chip_bytes"]
+        rendered.append("# memory: ZeRO residency split (per-chip "
+                        "resident param+opt bytes, measured)")
+        rendered.append("| config | per-chip bytes | compiled temp |\n"
+                        "|---|---|---|")
+        for which in ("dense", "zero2", "zero3"):
+            temp = (out["zero"]["compiled"].get(which) or {}).get(
+                "temp_size_in_bytes", "")
+            rendered.append(f"| {which} | {pc[which]} | {temp} |")
+        rendered.append(
+            f"\ndense/zero3 ratio: "
+            f"{out['zero']['dense_over_zero3_ratio']} at world="
+            f"{out['zero']['world_size']} (~world# within padding + "
+            f"replicated-bias slack)")
+    elif args.model == "serve":
+        out["serve_pool"] = memory_mod.serve_pool_report()
+        sp = out["serve_pool"]
+        rendered.append("# memory: serve KV-pool accounting")
+        rendered.append(
+            f"pool {sp['pool_bytes']} B ({sp['usable_pages']} usable "
+            f"pages x {sp['bytes_per_page']} B); occupancy "
+            f"{sp['occupancy']} ({sp['pages_in_use']} pages, "
+            f"{sp['bytes_in_use']} B in use)")
+        rendered.append(
+            f"capacity at the same pool budget: bf16 "
+            f"{sp['bf16_seqs_at_budget']} vs fp8 "
+            f"{sp['fp8_seqs_at_budget']} concurrent seqs "
+            f"(ratio {sp['fp8_capacity_ratio']})")
+    else:
+        step, step_args = profile_mod.demo_train_step(args.model)
+        prof = memory_mod.memory_profile(step, *step_args,
+                                         label=f"{args.model}_step")
+        out["profile"] = prof
+        rendered.append(memory_mod.render_memory_profile(
+            prof, max_rows=args.max_rows))
+        if args.live:
+            import jax
+            rec = monitor.Recorder(name="memory-cli",
+                                   traced_hooks=False)
+            with monitor.attached(rec), \
+                    memory_mod.MemorySampler(args.interval):
+                for _ in range(max(1, args.steps)):
+                    step_out = step(*step_args)
+                jax.block_until_ready(step_out)
+            agg = rec.aggregate()
+            out["live"] = {"memory": agg.get("memory"),
+                           "histograms": agg.get("histograms")}
+            from apex_tpu.monitor import report as report_mod
+            live_render = report_mod.render_memory(agg)
+            if live_render:
+                rendered.append("\n# live HBM timeline "
+                                "(MemorySampler)\n")
+                rendered.append(live_render)
+    if not args.no_calibration and args.model in ("gpt", "mlp"):
+        cal = memory_mod.vmem_calibration()
+        out["vmem_calibration"] = cal
+        rendered.append(f"\nvmem calibration: {cal['checked']} kernel "
+                        f"config(s) checked, {cal['mispredicts']} "
+                        f"envelope mispredict(s)")
+        for row in cal["rows"]:
+            rendered.append(
+                f"- {row['kernel']} [{row['source']}] "
+                f"{row['config']}: predicted "
+                f"{row['predicted_vmem_bytes']} B vs compiled temp "
+                f"{row['measured_temp_bytes']} B"
+                f"{'  ** MISPREDICT **' if row['mispredict'] else ''}")
+    if args.json:
+        print(json.dumps(json_safe(out), indent=2))
+    else:
+        print("\n".join(rendered))
     return 0
 
 

@@ -44,6 +44,30 @@ same observer sees them with no serve-specific wiring):
                            queue head cannot be admitted (pool or
                            batch slots too small for the traffic)
 
+Memory conditions (the OOM-forecast layer — ``monitor.memory``'s
+sampler/snapshot gauges ride ordinary step records, so the same
+observer sees them with no memory-specific wiring):
+
+- ``hbm_high_water``       ``memory/hbm_bytes_in_use`` at/above
+                           ``hbm_high_water_fraction`` of
+                           ``memory/hbm_limit_bytes`` — the allocator
+                           is about to OOM on the next spike;
+                           hysteresis re-arm below 90% of the bar
+- ``memory_leak``          positive least-squares slope of the
+                           ``memory/hbm_bytes_in_use`` step gauge over
+                           a full ``leak_window``, with predicted
+                           growth over the window at/above
+                           ``leak_rel_threshold`` of the window mean
+                           (a constant footprint NEVER fires — the
+                           false-positive guard is tested)
+- ``recompile_storm``      backend compiles / jit-cache misses landing
+                           in >= ``recompile_trips`` of the last
+                           ``recompile_window`` steps after a
+                           ``recompile_grace`` warmup — a shape or
+                           static-arg churn is retracing every step
+                           (and each retrace's executable + buffers
+                           inflate HBM: the classic slow-motion OOM)
+
 Each detection emits one typed ``health_event`` record into the
 recorder — ``{"kind": "health_event", "name": <condition>, "severity",
 "diagnosis", ...}`` — which rides the JSONL dump, shows up in
@@ -67,6 +91,7 @@ HEALTH_EVENT_KINDS = (
     "nan", "overflow_storm", "loss_divergence", "loss_plateau",
     "loader_starvation", "straggler",
     "kv_pool_exhaustion", "eviction_storm", "admission_starvation",
+    "hbm_high_water", "memory_leak", "recompile_storm",
 )
 
 
@@ -112,6 +137,11 @@ class Watchdog:
                  eviction_window: int = 20, eviction_trips: int = 3,
                  admission_age_s: float = 30.0,
                  admission_smoothing: float = 0.3,
+                 hbm_high_water_fraction: float = 0.9,
+                 leak_window: int = 20,
+                 leak_rel_threshold: float = 0.05,
+                 recompile_window: int = 10, recompile_trips: int = 3,
+                 recompile_grace: int = 3,
                  diagnostics_steps: int = 16,
                  scaler=None):
         self.on_event = on_event
@@ -132,6 +162,12 @@ class Watchdog:
         self.eviction_trips = int(eviction_trips)
         self.admission_age_s = float(admission_age_s)
         self.admission_smoothing = float(admission_smoothing)
+        self.hbm_high_water_fraction = float(hbm_high_water_fraction)
+        self.leak_window = int(leak_window)
+        self.leak_rel_threshold = float(leak_rel_threshold)
+        self.recompile_window = int(recompile_window)
+        self.recompile_trips = int(recompile_trips)
+        self.recompile_grace = int(recompile_grace)
         self.diagnostics_steps = int(diagnostics_steps)
         self.scaler = scaler            # optional LossScaler for bundles
         self.events: list[dict] = []
@@ -160,6 +196,14 @@ class Watchdog:
         self._evict_active = False
         self._queue_age_ema: Optional[float] = None
         self._admission_starved = False
+        # memory detection state
+        self._hbm_high = False
+        self._leak_hist: collections.deque = collections.deque(
+            maxlen=self.leak_window)
+        self._leak_fired = False
+        self._recompile_hist: collections.deque = collections.deque(
+            maxlen=self.recompile_window)
+        self._recompile_active = False
         self._n_steps = 0
         if recorder is not None:
             self.watch(recorder)
@@ -328,6 +372,110 @@ class Watchdog:
                 self._starving = False
 
         self._serve_checks(rec, step, step_ev, gauges)
+        self._memory_checks(rec, step, step_ev, gauges)
+
+    # -- memory analysis (the OOM-forecast layer) ---------------------------
+    def _memory_checks(self, rec, step, step_ev: dict, gauges: dict):
+        """``monitor.memory``'s sampler/snapshot gauges ride ordinary
+        step records; these three conditions fire BEFORE an OOM does.
+        One early-out on a step with no memory signal."""
+        in_use = gauges.get("memory/hbm_bytes_in_use")
+        limit = gauges.get("memory/hbm_limit_bytes")
+        counters = step_ev.get("counters") or {}
+        timers = step_ev.get("timers") or {}
+        compiled = bool(counters.get("jax/compile/cache_miss")
+                        or "jax/compile/backend" in timers)
+
+        # 1) recompile storm: compile events landing step after step
+        # once warmup is over — beyond the wall-clock cost, every
+        # retrace's executable and its buffers inflate HBM (the
+        # slow-motion OOM the two gauges below then confirm). The
+        # tracker runs on EVERY step: a quiet step must push a 0, or
+        # sparse one-off compiles across a long run would read as
+        # consecutive and fire a false storm.
+        if self._n_steps > self.recompile_grace:
+            self._recompile_hist.append(1 if compiled else 0)
+            trips = sum(self._recompile_hist)
+            if trips >= self.recompile_trips \
+                    and not self._recompile_active:
+                self._recompile_active = True
+                self._fire(
+                    rec, "recompile_storm", trips,
+                    f"jit compiles landed in {trips} of the last "
+                    f"{len(self._recompile_hist)} steps (step {step}, "
+                    f"after a {self.recompile_grace}-step warmup "
+                    "grace): a shape, dtype or static-arg is changing "
+                    "every step and XLA is retracing instead of "
+                    "reusing — pad to fixed shapes or hoist the "
+                    "varying value out of the static args. Each "
+                    "retrace also leaks executable + buffer HBM "
+                    "(watch memory/hbm_bytes_in_use).",
+                    severity="warn", step=step,
+                    window=len(self._recompile_hist))
+            elif trips == 0:
+                self._recompile_active = False
+
+        if in_use is None and limit is None:
+            return
+
+        # 2) hbm high water: usage at/above the fraction of the limit —
+        # the next allocation spike (a retrace, a bigger batch, a
+        # fragmentation miss) OOMs. Hysteresis re-arm at 90% of the bar.
+        if in_use is not None and limit and _finite(in_use) \
+                and _finite(limit):
+            frac = float(in_use) / float(limit)
+            if frac >= self.hbm_high_water_fraction:
+                if not self._hbm_high:
+                    self._hbm_high = True
+                    self._fire(
+                        rec, "hbm_high_water", round(frac, 4),
+                        f"HBM at {100 * frac:.0f}% of the device limit "
+                        f"at step {step} ({int(in_use)}/{int(limit)} "
+                        f"bytes, bar "
+                        f"{100 * self.hbm_high_water_fraction:.0f}%): "
+                        "the next allocation spike OOMs. Shrink the "
+                        "batch/activation footprint (remat, ZeRO "
+                        "shard_params, fp8-KV) or move state off-chip "
+                        "before the allocator does it for you with a "
+                        "crash.",
+                        severity="error", step=step,
+                        bytes_in_use=int(in_use), limit_bytes=int(limit))
+            elif frac < 0.9 * self.hbm_high_water_fraction:
+                self._hbm_high = False        # hysteresis: re-arm
+
+        # 3) memory leak: positive least-squares slope over a FULL
+        # sliding window of the step byte gauge, with the predicted
+        # growth over the window at least ``leak_rel_threshold`` of the
+        # window mean — a flat footprint (slope ~0) and ordinary
+        # sample noise never fire (the false-positive guard).
+        if in_use is not None and _finite(in_use):
+            self._leak_hist.append(float(in_use))
+            if (len(self._leak_hist) == self.leak_window
+                    and not self._leak_fired):
+                ys = list(self._leak_hist)
+                n = len(ys)
+                xbar = (n - 1) / 2.0
+                ybar = sum(ys) / n
+                denom = sum((i - xbar) ** 2 for i in range(n))
+                slope = sum((i - xbar) * (y - ybar)
+                            for i, y in enumerate(ys)) / denom
+                growth = slope * (n - 1)
+                if slope > 0 and ybar > 0 \
+                        and growth >= self.leak_rel_threshold * ybar:
+                    self._leak_fired = True
+                    self._fire(
+                        rec, "memory_leak", round(slope, 2),
+                        f"memory/hbm_bytes_in_use grew "
+                        f"~{int(growth)} bytes over the last {n} steps "
+                        f"({100 * growth / ybar:.1f}% of the mean "
+                        f"footprint, slope {slope:.0f} B/step) at step "
+                        f"{step}: something is accumulating per step — "
+                        "a python-side list of device arrays, an "
+                        "unbounded cache, or a new executable per step "
+                        "(check recompile_storm). At this rate the "
+                        "high-water bar is a matter of steps.",
+                        severity="warn", step=step,
+                        growth_bytes=int(growth), window=n)
 
     # -- serve-side analysis ------------------------------------------------
     def _serve_checks(self, rec, step, step_ev: dict, gauges: dict):
@@ -477,8 +625,8 @@ class Watchdog:
             except Exception:
                 pass
         try:
-            from apex_tpu.monitor import trace as _trace
-            bundle["device_memory"] = _trace.device_memory_snapshot()
+            from apex_tpu.monitor import memory as _memory
+            bundle["device_memory"] = _memory.device_memory_snapshot()
         except Exception:
             bundle["device_memory"] = []
         return bundle

@@ -94,6 +94,13 @@ def suffix_unit(name: str) -> str:
         return "ratio"
     if "loss" in name:
         return "loss"
+    # byte metrics (r15 on: the monitor.memory layer registers its
+    # bench keys here so `monitor regress` gates them lower-better)
+    if name.endswith(("_bytes", "_bytes_per_chip", "_bytes_per_page",
+                      "_bytes_in_use")) or "_bytes_" in name:
+        return "bytes"
+    if "occupancy" in name:
+        return "fraction (pool occupancy)"
     return ""
 
 
@@ -238,10 +245,12 @@ def metric_direction(name: str, unit: str) -> Optional[str]:
     """"higher"/"lower" = which way is better; None = unknown (such a
     metric can be reported but never gates)."""
     base = unit.split(" (")[0]
-    if base in ("ms", "s") or name.endswith(("_ms", "_s")) \
+    if base in ("ms", "s", "bytes") or name.endswith(("_ms", "_s")) \
             or "_ms_" in name or "idle" in name or "bubble" in name \
             or "bytes" in name or "loss" in name or base == "loss" \
-            or "ttft" in name or "queue_wait" in name:
+            or "ttft" in name or "queue_wait" in name \
+            or "occupancy" in name or "mispredict" in name \
+            or "utilization" in name:
         return "lower"
     if "/sec" in base or base in ("mfu", "ratio") or "per_sec" in name \
             or "speedup" in name or "mfu" in name or name == "vs_baseline" \

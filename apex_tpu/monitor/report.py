@@ -61,8 +61,11 @@ def aggregate(events: Iterable[dict], header: Optional[dict] = None) -> dict:
     histograms: dict[str, dict] = {}   # cumulative snapshots; last wins
     span_ends: list[dict] = []
     span_events: dict[str, int] = {}
+    memory_rows: dict[str, dict] = {}    # per-program footprints
+    memory_scopes: dict[str, dict] = {}  # per-scope analytic peaks
     gauge_series: dict[str, list] = {}   # trajectory-tracked gauges
-    _TRACKED_GAUGES = ("serve/queue_depth", "serve/batch_fill")
+    _TRACKED_GAUGES = ("serve/queue_depth", "serve/batch_fill",
+                       "memory/hbm_bytes_in_use")
     steps: list[dict] = []
     health: list[dict] = []
     for ev in events:
@@ -128,6 +131,24 @@ def aggregate(events: Iterable[dict], header: Optional[dict] = None) -> dict:
                 if ev.get(k) is not None:
                     row[k] = ev[k]
             profile_rows[name] = row
+        elif kind == "memory":
+            # per-program footprint rows (monitor.memory,
+            # memory_profile/compiled_memory_profile(record=True));
+            # last emission wins
+            row = {"total_bytes": ev.get("value")}
+            for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                      "temp_size_in_bytes", "alias_size_in_bytes",
+                      "generated_code_size_in_bytes",
+                      "analytic_peak_bytes", "peak_scope", "estimated",
+                      "argument_bytes", "output_bytes"):
+                if ev.get(k) is not None:
+                    row[k] = ev[k]
+            memory_rows[name] = row
+        elif kind == "memory_scope":
+            # per-scope analytic peak-live-bytes rows
+            # (monitor.memory.analytic_high_water(record=True))
+            memory_scopes[name] = {"peak_live_bytes": ev.get("value"),
+                                   "eqns": ev.get("eqns")}
         elif kind == "step":
             steps.append(ev)
         elif kind == "health_event":
@@ -208,6 +229,9 @@ def aggregate(events: Iterable[dict], header: Optional[dict] = None) -> dict:
                          counters)
     if serve:
         out["serve"] = serve
+    mem = _memory_block(memory_rows, memory_scopes, gauges, gauge_series)
+    if mem:
+        out["memory"] = mem
     if health:
         out["health"] = health
     return out
@@ -276,6 +300,42 @@ def _serve_block(span_ends, histograms, gauges, gauge_series, counters):
     if "serve/goodput_tokens_per_sec_chip" in serve_gauges:
         out["goodput_tokens_per_sec_chip"] = \
             serve_gauges["serve/goodput_tokens_per_sec_chip"]
+    return out
+
+
+def _memory_block(memory_rows, memory_scopes, gauges, gauge_series):
+    """The unified memory view: per-program compiled footprints
+    (``memory`` events), per-scope analytic peaks (``memory_scope``
+    events), the live gauges, and the downsampled HBM timeline from
+    the sampler's ``memory/hbm_bytes_in_use`` step gauge."""
+    mem_gauges = {k: v for k, v in gauges.items()
+                  if k.startswith("memory/")}
+    if not (memory_rows or memory_scopes or mem_gauges):
+        return None
+    out: dict = {}
+    if memory_rows:
+        out["programs"] = {k: memory_rows[k]
+                           for k in sorted(memory_rows)}
+    if memory_scopes:
+        # ties (the top jaxpr's output equation sees the same live
+        # bytes under no scope) resolve to the NAMED scope
+        from apex_tpu.monitor.profile import UNSCOPED
+        peak = max(memory_scopes.items(),
+                   key=lambda kv: (kv[1].get("peak_live_bytes") or 0,
+                                   kv[0] != UNSCOPED))
+        out["analytic"] = {
+            "peak_live_bytes": peak[1].get("peak_live_bytes"),
+            "peak_scope": peak[0],
+            "scopes": {k: memory_scopes[k]
+                       for k in sorted(memory_scopes)}}
+    if mem_gauges:
+        out["gauges"] = mem_gauges
+    series = gauge_series.get("memory/hbm_bytes_in_use")
+    if series:
+        vals = [v for _, v in series]
+        out["timeline"] = {"samples": len(vals), "max": max(vals),
+                           "last": vals[-1],
+                           "trajectory": _downsample(series)}
     return out
 
 
@@ -388,6 +448,76 @@ def render_serve(agg: dict, max_rows: int = 50) -> Optional[str]:
     return "\n".join(parts)
 
 
+def _fmt_bytes(v) -> str:
+    if v is None or v == "":
+        return ""
+    v = float(v)
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10)):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{unit}"
+    return f"{v:.0f}B"
+
+
+def render_memory(agg: dict, max_rows: int = 30) -> Optional[str]:
+    """Render the ``memory`` block of an :func:`aggregate` result:
+    per-program footprint table, per-scope analytic peaks, the live
+    gauges and the HBM timeline summary. ``None`` when no memory
+    telemetry was recorded. Used by ``render_report`` and the
+    ``python -m apex_tpu.monitor memory`` CLI."""
+    mem = agg.get("memory")
+    if not mem:
+        return None
+    parts = ["## memory\n"]
+    progs = mem.get("programs") or {}
+    if progs:
+        parts.append("| program | total | argument | output | temp | "
+                     "analytic peak | peak scope |\n"
+                     "|---|---|---|---|---|---|---|")
+        for name in sorted(progs):
+            row = progs[name]
+            parts.append(
+                f"| {name} | {_fmt_bytes(row.get('total_bytes'))} "
+                f"| {_fmt_bytes(row.get('argument_size_in_bytes', row.get('argument_bytes')))} "
+                f"| {_fmt_bytes(row.get('output_size_in_bytes', row.get('output_bytes')))} "
+                f"| {_fmt_bytes(row.get('temp_size_in_bytes'))} "
+                f"| {_fmt_bytes(row.get('analytic_peak_bytes'))} "
+                f"| {row.get('peak_scope', '')} |")
+    analytic = mem.get("analytic") or {}
+    scopes = analytic.get("scopes") or {}
+    if scopes:
+        parts.append(
+            f"\nanalytic high water: "
+            f"{_fmt_bytes(analytic.get('peak_live_bytes'))} at scope "
+            f"`{analytic.get('peak_scope')}`\n")
+        parts.append("| scope | peak live | eqns |\n|---|---|---|")
+        order = sorted(scopes.items(),
+                       key=lambda kv: -(kv[1].get("peak_live_bytes")
+                                        or 0))
+        for name, row in order[:max_rows]:
+            parts.append(f"| {name} "
+                         f"| {_fmt_bytes(row.get('peak_live_bytes'))} "
+                         f"| {row.get('eqns', '')} |")
+        if len(order) > max_rows:
+            parts.append(f"... ({len(order) - max_rows} more scopes)")
+    tl = mem.get("timeline")
+    if tl:
+        parts.append(f"\nhbm timeline: {tl['samples']} samples, "
+                     f"max {_fmt_bytes(tl['max'])}, "
+                     f"last {_fmt_bytes(tl['last'])}")
+    g = mem.get("gauges") or {}
+    line = []
+    if "memory/hbm_bytes_in_use" in g:
+        line.append(f"in use {_fmt_bytes(g['memory/hbm_bytes_in_use'])}")
+    if "memory/hbm_limit_bytes" in g:
+        line.append(f"limit {_fmt_bytes(g['memory/hbm_limit_bytes'])}")
+    if "memory/hbm_utilization" in g:
+        line.append(f"utilization "
+                    f"{100.0 * g['memory/hbm_utilization']:.2f}%")
+    if line:
+        parts.append("hbm: " + ", ".join(line))
+    return "\n".join(parts)
+
+
 def render_report(events: list[dict], header: Optional[dict] = None,
                   max_rows: int = 50) -> str:
     """Full human-readable report: per-step table + aggregates."""
@@ -408,6 +538,9 @@ def render_report(events: list[dict], header: Optional[dict] = None,
     serve = render_serve(agg, max_rows=max_rows)
     if serve:
         parts.append("\n" + serve)
+    mem = render_memory(agg, max_rows=max_rows)
+    if mem:
+        parts.append("\n" + mem)
     parts.append("\n## per-step\n")
     parts.append(render_steps(events, max_rows=max_rows))
     if "steps" in agg:

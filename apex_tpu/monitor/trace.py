@@ -17,8 +17,10 @@ This subsumes ``apex_tpu.pyprof`` (which is now a thin re-export shim):
   whichever recorder is attached at the time it happens. Idempotent,
   and a no-op while monitoring is disabled (the listener checks the
   guard per event).
-- :func:`device_memory_snapshot` / :func:`memory_analysis` — runtime
-  per-device memory stats and compiled-executable memory breakdowns.
+- :func:`device_memory_snapshot` / :func:`memory_analysis` —
+  DEPRECATED re-export shims over :mod:`apex_tpu.monitor.memory`, the
+  one memory surface (compiled footprints, analytic high water, the
+  live HBM sampler).
 
 All jax imports are deferred to call time: importing this module (and
 therefore ``apex_tpu.monitor``) does no jax work (APX001 discipline).
@@ -196,47 +198,25 @@ def compile_seconds(recorder=None) -> float:
 
 
 # ---------------------------------------------------------------------------
-# memory
+# memory — moved to apex_tpu.monitor.memory (thin re-export shims)
 # ---------------------------------------------------------------------------
 
 def device_memory_snapshot(devices=None) -> list[dict]:
-    """Per-device live memory stats (``bytes_in_use``, ``peak_bytes``...
-    whatever the platform reports; CPU backends report nothing and get
-    an empty stats dict). Recorded as gauges when a recorder is
-    attached."""
-    import jax
-    devices = devices if devices is not None else jax.local_devices()
-    out = []
-    rec = _state.recorder
-    for d in devices:
-        try:
-            stats = d.memory_stats() or {}
-        except Exception:
-            stats = {}
-        row = {"device": str(d), "platform": d.platform, **stats}
-        out.append(row)
-        if rec is not None and stats:
-            for k in ("bytes_in_use", "peak_bytes_in_use"):
-                if k in stats:
-                    rec.gauge(f"memory/{d.id}/{k}", stats[k])
-    return out
+    """DEPRECATED location: use
+    :func:`apex_tpu.monitor.memory.device_memory_snapshot` — the ONE
+    memory surface (the pyprof/xentropy re-export precedent). This shim
+    delegates; new callers get the extended rows (nominal degradation
+    on stats-less backends, limit/utilization, the headline
+    ``memory/hbm_bytes_in_use`` gauge)."""
+    from apex_tpu.monitor import memory as _memory
+    return _memory.device_memory_snapshot(devices)
 
 
 def memory_analysis(fn, *args, **kwargs) -> dict:
-    """Compiled-executable memory breakdown for ``fn(*args)`` — the
-    static numbers XLA's allocator will honor (argument/output/temp/
-    generated-code sizes, in bytes). Complements the runtime snapshot:
-    this is per-program, known before the first run."""
-    import jax
-    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
-    ma = compiled.memory_analysis()
-    if ma is None:
-        return {}
-    out = {}
-    for k in ("argument_size_in_bytes", "output_size_in_bytes",
-              "temp_size_in_bytes", "alias_size_in_bytes",
-              "generated_code_size_in_bytes"):
-        v = getattr(ma, k, None)
-        if v is not None:
-            out[k] = int(v)
-    return out
+    """DEPRECATED location: use
+    :func:`apex_tpu.monitor.memory.compiled_memory_profile` — same
+    compiled breakdown plus the ``total_bytes`` envelope and the
+    ``record=`` path into ``report.aggregate()["memory"]``. This shim
+    delegates (key set is a superset of the historical one)."""
+    from apex_tpu.monitor import memory as _memory
+    return _memory.compiled_memory_profile(fn, *args, **kwargs)

@@ -861,6 +861,40 @@ def convergence_checks(out):
     return result
 
 
+def _ring_s32k_precheck():
+    """The r06-r08 full-run killer, pre-checked: off-TPU the flash
+    kernel runs in Pallas interpret mode (`_resolve_interpret`), and
+    ONE interpret-mode fwd+bwd call at s=32k is a single uninterruptible
+    native dispatch that outlives any SIGALRM budget — three rounds in a
+    row died inside it with only the streamed sections surviving. Skip
+    and record on platforms that would interpret, BEFORE any array is
+    built, so a full round finishes the sections past it.
+    ``BENCH_RING_S32K_FORCE=1`` overrides (e.g. to price interpret mode
+    deliberately under an external kill)."""
+    if os.environ.get("BENCH_RING_S32K_FORCE") == "1":
+        return None
+    import jax
+    from apex_tpu.ops.flash_attention import _resolve_interpret
+    if _resolve_interpret(None):
+        return (f"interpret-mode flash at s=32k on backend "
+                f"'{jax.default_backend()}' is one uninterruptible "
+                "native call that outlives any section budget (killed "
+                "r06-r08 full runs mid-call); pre-checked and skipped "
+                "— set BENCH_RING_S32K_FORCE=1 to run it anyway")
+    return None
+
+
+def _bench_ring_s32k_guarded():
+    """Section wrapper: the interpret-mode pre-check decides between
+    the real s=32k body and a skip-and-record row (regression-tested
+    by tests/test_bench_stream.py — sections after this one must
+    complete on a CPU host)."""
+    skip = _ring_s32k_precheck()
+    if skip is not None:
+        return {"ring_s32k_skipped": skip}
+    return {"ring_s32k": _bench_ring_s32k()}
+
+
 def _bench_ring_s32k():
     """Long-context flagship datapoint (VERDICT r4 next #8): s=32k
     causal attention fwd+bwd on one chip, flat flash kernel vs the
@@ -1317,13 +1351,10 @@ def _bench_zero_sharded():
                          - y) ** 2)
 
     def per_chip_bytes(tree):
-        dev0 = devs[0]
-        total = 0
-        for leaf in jax.tree.leaves(tree):
-            for sh in getattr(leaf, "addressable_shards", []):
-                if sh.device == dev0:
-                    total += sh.data.nbytes
-        return total
+        # the ONE residency measurement (monitor.memory) — the memory
+        # bench section re-derives this split through the same call
+        from apex_tpu.monitor.memory import resident_bytes
+        return resident_bytes(tree, device=devs[0])
 
     # the rank-varying/replicated split of each config's state tree,
     # known statically (the same decision table zero.build_spec uses)
@@ -2121,6 +2152,115 @@ def _bench_serve_decode():
     return out
 
 
+def _bench_memory():
+    """The unified memory evidence (monitor.memory, ISSUE 15): every
+    byte claim in this section is derived THROUGH the memory layer —
+    no bench-local accounting. Same code in smoke and full: residency
+    and pool math are backend-independent, the analytic walk is
+    abstract, and the sampler degrades to the nominal cpu row by
+    design (platform-bound keys are unit-stamped per round).
+
+    Asserted in-section (the PR's acceptance criteria):
+    - the ZeRO dense/zero3 per-chip resident-byte ratio, measured by
+      ``memory.zero_memory_report`` (``resident_bytes`` on device 0),
+      reproduces ~world# at world=8 within the PR 6 padding +
+      replicated-bias slack;
+    - the serve pool occupancy/capacity numbers come from
+      ``memory.serve_pool_report`` (``CacheConfig`` byte accounting)
+      and the fp8 capacity ratio holds >= 2x;
+    - the analytic high-water walk attributes the canonical GPT step's
+      peak to a NAMED ``apx:`` scope (not ``(unscoped)``).
+
+    The per-scope rows and footprint table land in the evidence stream
+    as typed ``memory``/``memory_scope`` events; the sampler's gauges
+    make ``memory/`` keys scrapeable by the ci export stage."""
+    import jax
+    from apex_tpu import monitor
+    from apex_tpu.monitor import memory as memory_mod
+    from apex_tpu.monitor import profile as prof_mod
+
+    out = {}
+
+    # 1) ZeRO residency split THROUGH the layer (not bench-local): the
+    # exact per-chip bytes PR 6 measured, now a monitor.memory product
+    zr = memory_mod.zero_memory_report(record=True)
+    world = zr["world_size"]
+    pc = zr["per_chip_bytes"]
+    ratio = zr["dense_over_zero3_ratio"]
+    if world >= 4:
+        assert 0.7 * world <= ratio <= 1.2 * world, \
+            f"dense/zero3 residency ratio {ratio} not ~world# " \
+            f"(world={world}; per-chip {pc})"
+    out.update({
+        "memory_zero_world_size": world,
+        "memory_zero_dense_bytes_per_chip": pc["dense"],
+        "memory_zero_zero2_bytes_per_chip": pc["zero2"],
+        "memory_zero_zero3_bytes_per_chip": pc["zero3"],
+        "memory_zero_dense_over_zero3_ratio": ratio,
+    })
+    for which, cm in zr["compiled"].items():
+        if "temp_size_in_bytes" in cm:
+            out[f"memory_zero_{which}_compiled_temp_bytes"] = \
+                cm["temp_size_in_bytes"]
+
+    # 2) compiled footprint + analytic high water of the canonical GPT
+    # step (the ONE profile recipe) — "which module owns the peak" must
+    # have a named answer
+    step, step_args = prof_mod.demo_train_step("gpt")
+    prof = memory_mod.memory_profile(step, *step_args, label="gpt_step",
+                                     record=True)
+    hw = prof["analytic"]
+    assert hw["peak_scope"] != prof_mod.UNSCOPED \
+        and hw["peak_live_bytes"] > 0, \
+        f"analytic peak lost its scope attribution: {hw['peak_scope']}"
+    out["memory_gpt_analytic_peak_bytes"] = hw["peak_live_bytes"]
+    out["memory_gpt_peak_scope"] = hw["peak_scope"]
+    cm = prof["compiled"]
+    if cm:
+        out["memory_gpt_compiled_total_bytes"] = cm["total_bytes"]
+        out["memory_gpt_compiled_temp_bytes"] = \
+            cm.get("temp_size_in_bytes", 0)
+
+    # 3) live HBM timeline: a few executed steps under the sampler —
+    # real stats on TPU, the nominal live-arrays row on a CPU host
+    # (either way the gauges/histogram land in the evidence stream
+    # and the export stage scrapes them)
+    with memory_mod.MemorySampler(0.02):
+        for _ in range(3):
+            step_out = step(*step_args)
+        jax.block_until_ready(step_out)
+    rec = monitor.get_recorder()
+    if rec is not None:
+        g = rec.gauges()
+        if "memory/hbm_bytes_in_use" in g:
+            out["memory_hbm_bytes_in_use"] = int(
+                g["memory/hbm_bytes_in_use"])
+        if "memory/hbm_utilization" in g:
+            out["memory_hbm_utilization"] = round(
+                g["memory/hbm_utilization"], 6)
+
+    # 4) serve pool occupancy THROUGH the layer (CacheConfig byte
+    # accounting — the PR 11 capacity claim's accounting, re-reported
+    # as a gated metric from this round on)
+    sp = memory_mod.serve_pool_report(record=True)
+    assert sp["fp8_capacity_ratio"] >= 2.0, \
+        f"fp8-KV capacity ratio {sp['fp8_capacity_ratio']} < 2.0"
+    out.update({
+        "serve_pool_occupancy": sp["occupancy"],
+        "memory_serve_pool_bytes": sp["pool_bytes"],
+        "memory_serve_pool_bytes_in_use": sp["bytes_in_use"],
+        "memory_serve_bytes_per_page": sp["bytes_per_page"],
+        "memory_serve_fp8_bytes_per_page": sp["fp8_bytes_per_page"],
+    })
+
+    # 5) tuner feedback loop: envelope predictions vs compiled temp
+    # bytes at the tiny calibration shapes (interpret off-TPU)
+    cal = memory_mod.vmem_calibration(record=True)
+    out["memory_vmem_configs_checked"] = cal["checked"]
+    out["memory_vmem_mispredicts"] = cal["mispredicts"]
+    return out
+
+
 def _bench_gpt_moe():
     """GPT with every-other-block MoE (8 experts, dense mesh —
     single-chip expert compute): the expert-parallel surface's
@@ -2373,6 +2513,30 @@ _METRIC_UNITS = {
     "multi_tensor_n_candidates": "count",
     "multi_tensor_cache_hits": "count",
     "multi_tensor_shard_elems": "elements",
+    # the r15 memory section (monitor.memory): byte keys gate
+    # lower-better from r09 on. Residency/pool/analytic bytes are
+    # platform-INDEPENDENT (exact layout math at fixed world=8 /
+    # geometry — deterministic cross-round priors); the sampler keys
+    # are platform-bound and get the per-round host stamp.
+    "memory_zero_dense_bytes_per_chip":
+        "bytes (device-local resident, world=8)",
+    "memory_zero_zero2_bytes_per_chip":
+        "bytes (device-local resident, world=8)",
+    "memory_zero_zero3_bytes_per_chip":
+        "bytes (device-local resident, world=8)",
+    "memory_zero_dense_over_zero3_ratio":
+        "ratio (dense vs ZeRO-3 per-chip resident bytes)",
+    "memory_gpt_analytic_peak_bytes":
+        "bytes (analytic high-water, tiny-GPT recipe)",
+    "memory_serve_pool_bytes": "bytes (KV pool, bench geometry)",
+    "memory_serve_pool_bytes_in_use": "bytes (KV pool, bench geometry)",
+    "memory_serve_bytes_per_page": "bytes (KV pool, bench geometry)",
+    "memory_serve_fp8_bytes_per_page": "bytes (KV pool, bench geometry)",
+    "serve_pool_occupancy": "fraction (pool occupancy)",
+    "memory_hbm_utilization": "utilization of HBM limit (live sampler)",
+    "memory_zero_world_size": "devices (mesh world)",
+    "memory_vmem_configs_checked": "count",
+    "memory_vmem_mispredicts": "count (envelope under-predictions)",
 }
 
 
@@ -2578,7 +2742,7 @@ def _sections_full(ctx: dict, rec) -> list:
     sections += [
         ("bert", 1200, bert),
         ("gpt_moe", 1500, gpt_moe),
-        ("ring_s32k", 2400, lambda: {"ring_s32k": _bench_ring_s32k()}),
+        ("ring_s32k", 2400, _bench_ring_s32k_guarded),
         ("dispatch_overhead", 300,
          lambda: {"dispatch_overhead": _bench_dispatch_overhead()}),
         ("tp_overlap", 300, _bench_tp_overlap),
@@ -2591,6 +2755,7 @@ def _sections_full(ctx: dict, rec) -> list:
         ("multi_tensor_update", 240, _bench_multi_tensor_update),
         ("profile", 120, _bench_profile),
         ("serve_decode", 300, _bench_serve_decode),
+        ("memory", 300, _bench_memory),
         ("monitor", 120, lambda: _monitor_extras(rec)),
     ]
     return sections
@@ -2602,7 +2767,7 @@ SMOKE_EXPECTED = ("smoke_mlp_amp", "smoke_fused_adam",
                   "smoke_noop_dispatch", "tp_overlap", "ddp_bucket_overlap",
                   "pp_zero_bubble", "zero_sharded_step", "fp8_step",
                   "autotune", "fused_ln", "multi_tensor_update",
-                  "profile", "serve_decode",
+                  "profile", "serve_decode", "memory",
                   "smoke_timeout_probe", "monitor")
 
 
@@ -2711,6 +2876,10 @@ def _sections_smoke(ctx: dict, rec) -> list:
         # and the fp8 pool accounting hold on any backend (the engine
         # picks the kernel paths on TPU, the XLA references elsewhere)
         ("serve_decode", 240, _bench_serve_decode),
+        # same code in smoke and full: residency and pool math are
+        # backend-independent, the analytic walk is abstract, and the
+        # sampler degrades to the nominal cpu row by design
+        ("memory", 240, _bench_memory),
         ("smoke_timeout_probe", probe_budget, timeout_probe),
         ("monitor", 60, lambda: _monitor_extras(rec)),
     ]

@@ -36,15 +36,22 @@
 #                    the smoke-bench recorder stream must render as
 #                    valid Prometheus text exposition AND parse back to
 #                    the same values (the scrape == aggregate
-#                    self-check); plus `monitor profile --model gpt`
-#                    must report an MFU line from the per-device_kind
-#                    peak table
+#                    self-check) INCLUDING the memory/ gauges the
+#                    bench memory section samples; plus `monitor
+#                    profile --model gpt` must report an MFU line from
+#                    the per-device_kind peak table
+#   4c. memory     — python -m apex_tpu.monitor memory --model gpt
+#                    --json: the unified byte surface must attribute
+#                    the canonical step's analytic peak to a NAMED
+#                    apx: scope, report a compiled footprint, and run
+#                    the tune/vmem calibration rows
 #   5. regress     — python -m apex_tpu.monitor regress: the smoke
 #                    stream must load as an evidence round, and the
-#                    committed BENCH_r01-r08 rounds must degrade exactly
+#                    committed BENCH_r01-r09 rounds must degrade exactly
 #                    as documented (r05 no-evidence, r01 incomparable,
-#                    cpu-host rounds unit-marked) with no false
-#                    regression verdict
+#                    cpu-host rounds unit-marked, memory byte keys
+#                    registered lower-better) with no false regression
+#                    verdict
 set -uo pipefail
 cd "$(dirname "$0")/.."
 REPO_DIR="$(pwd)"
@@ -67,7 +74,8 @@ eps = set(d.get("entrypoints_analyzed", []))
 tabs = set(d.get("rules_tables_checked", []))
 missing_eps = {"serve_decode_step", "serve_prefill_step",
                "zero3_train_step", "fp8_train_step",
-               "fused_layer_norm_step", "zero_fused_update_step"} - eps
+               "fused_layer_norm_step", "zero_fused_update_step",
+               "memory_profiled_step"} - eps
 missing_tabs = {"serve.GPT_PARAM_RULES", "serve.CACHE_RULES",
                 "zero.DEFAULT_RULES"} - tabs
 if missing_eps or missing_tabs:
@@ -110,7 +118,8 @@ for line in open(sys.argv[1]):
         seen.add(ev.get("name"))
 missing = {"tp_overlap", "ddp_bucket_overlap", "pp_zero_bubble",
            "zero_sharded_step", "fp8_step", "autotune", "fused_ln",
-           "multi_tensor_update", "profile", "serve_decode"} - seen
+           "multi_tensor_update", "profile", "serve_decode",
+           "memory"} - seen
 if missing:
     print(f"ci: sections missing from bench stream: {sorted(missing)}")
     raise SystemExit(1)
@@ -129,10 +138,27 @@ if missing_slo and not any(k.endswith(("_error", "_skipped"))
     print(f"ci: serve section lost span-derived SLO keys: "
           f"{sorted(missing_slo)} (have: {sorted(serve)[:20]})")
     raise SystemExit(1)
+# the memory section's byte claims must come THROUGH monitor.memory:
+# the stream line carries the re-derived ZeRO residency + pool keys
+mem = next(ev.get("data") or {} for ev in
+           map(json.loads, open(sys.argv[1]))
+           if ev.get("kind") == "section"
+           and ev.get("name") == "memory")
+mem_keys = {"memory_zero_dense_bytes_per_chip",
+            "memory_zero_zero3_bytes_per_chip",
+            "memory_zero_dense_over_zero3_ratio",
+            "memory_gpt_analytic_peak_bytes", "serve_pool_occupancy"}
+missing_mem = mem_keys - set(mem)
+if missing_mem and not any(k.endswith(("_error", "_skipped"))
+                           for k in mem):
+    print(f"ci: memory section lost its byte keys: "
+          f"{sorted(missing_mem)} (have: {sorted(mem)[:20]})")
+    raise SystemExit(1)
 print("ci: tp_overlap + ddp_bucket_overlap + pp_zero_bubble + "
       "zero_sharded_step + fp8_step + autotune + fused_ln + "
-      "multi_tensor_update + profile + serve_decode "
-      "present in bench stream (serve SLO keys span-derived)")
+      "multi_tensor_update + profile + serve_decode + memory "
+      "present in bench stream (serve SLO keys span-derived, "
+      "memory byte keys re-derived through monitor.memory)")
 EOF
 
 echo "== ci: monitor export (Prometheus exposition) + profile MFU =="
@@ -149,6 +175,31 @@ JAX_PLATFORMS=cpu python -m apex_tpu.monitor profile --model gpt \
     > /tmp/ci_profile_mfu.txt || fail=1
 grep -q "^MFU: " /tmp/ci_profile_mfu.txt || {
   echo "ci: monitor profile lost its MFU line"; fail=1; }
+# the bench memory section's sampler gauges must be scrapeable: the
+# export of the smoke stream has to carry memory/ metrics
+grep -q "^apex_memory_" /tmp/ci_export.txt || {
+  echo "ci: export scrape carries no memory/ gauges"; fail=1; }
+
+echo "== ci: monitor memory (unified byte surface self-check) =="
+# the memory CLI must answer "which module owns the peak" with a NAMED
+# scope, report a compiled footprint, and run the vmem calibration
+JAX_PLATFORMS=cpu python -m apex_tpu.monitor memory --model gpt --json \
+    > /tmp/ci_memory.json || fail=1
+python - /tmp/ci_memory.json <<'EOF' || fail=1
+import json, sys
+d = json.load(open(sys.argv[1]))
+prof = d["profile"]
+hw = prof["analytic"]
+assert hw["peak_live_bytes"] > 0, hw
+assert hw["peak_scope"] != "(unscoped)", \
+    f"analytic peak lost its scope: {hw['peak_scope']}"
+assert prof["compiled"].get("total_bytes", 0) > 0, prof["compiled"]
+cal = d["vmem_calibration"]
+assert cal["checked"] >= 3, cal
+print(f"ci: monitor memory ok — peak {hw['peak_live_bytes']} B at "
+      f"`{hw['peak_scope']}`, {cal['checked']} vmem configs "
+      f"calibrated ({cal['mispredicts']} mispredicts)")
+EOF
 
 echo "== ci: bench-trajectory regression gate (monitor.regress) =="
 # 1) the smoke stream must load as an evidence round without crashing
@@ -165,7 +216,7 @@ python - <<'EOF' || fail=1
 import json, subprocess, sys
 p = subprocess.run(
     [sys.executable, "-m", "apex_tpu.monitor", "regress",
-     *[f"BENCH_r0{i}.json" for i in range(1, 9)], "--json"],
+     *[f"BENCH_r0{i}.json" for i in range(1, 10)], "--json"],
     capture_output=True, text=True)
 if p.returncode != 0:
     print(f"ci: regress over committed rounds exited {p.returncode}:\n"
@@ -174,7 +225,7 @@ if p.returncode != 0:
 rep = json.loads(p.stdout)
 by = {r["round"]: r for r in rep["rounds"]}
 assert by["r05"]["status"] == "no-evidence", by["r05"]
-assert by["r08"]["status"] == "ok", by["r08"]
+assert by["r09"]["status"] == "ok", by["r09"]
 inc = rep["metrics"]["value"].get("incomparable") or []
 assert any(i["round"] == "r01" for i in inc), rep["metrics"]["value"]
 # the r13 kernel cost-model keys are platform-independent: they must be
@@ -194,10 +245,26 @@ for k in [m for m in rep["metrics"]
     assert u, f"unregistered serve/MFU metric unit: {k}"
     assert metric_direction(k, u) is not None, \
         f"no gating direction for {k} ({u})"
+# the r15 memory byte keys + serve_pool_occupancy must be registered
+# with a known (lower-better) gating direction — bytes gate from r09 on
+mem_keys = [m for m in rep["metrics"]
+            if m.startswith("memory_") or m == "serve_pool_occupancy"]
+assert "memory_zero_dense_bytes_per_chip" in mem_keys \
+    and "serve_pool_occupancy" in mem_keys, \
+    f"memory keys missing from the r09 candidate: {sorted(mem_keys)}"
+for k in mem_keys:
+    u = rep["metrics"][k]["unit"]
+    assert u, f"unregistered memory metric unit: {k}"
+    # capacity metrics gate lower-better; counts/config metadata
+    # (world size, configs-checked) report without gating
+    if any(s in k for s in ("bytes", "occupancy", "utilization",
+                            "mispredict")):
+        assert metric_direction(k, u) == "lower", \
+            f"{k} must gate lower-better ({u})"
 assert not rep["regressions"], rep["regressions"]
-print("ci: regress gate ok over r01-r08 (r05 no-evidence, r01 "
-      "incomparable, kernel + serve-SLO/MFU metric units registered, "
-      "no false regressions)")
+print("ci: regress gate ok over r01-r09 (r05 no-evidence, r01 "
+      "incomparable, kernel + serve-SLO/MFU + memory byte metric "
+      "units registered lower-better, no false regressions)")
 EOF
 
 if [[ "$fail" == "0" ]]; then

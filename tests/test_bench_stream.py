@@ -284,3 +284,48 @@ def test_section_runner_skip_and_record(tmp_path):
         events = [json.loads(ln) for ln in f.read().splitlines()]
     names = [e["name"] for e in events if e["kind"] == "section"]
     assert names == ["ok", "bad", "hang", "late"]
+
+
+def test_ring_s32k_interpret_precheck_skips_and_continues(tmp_path):
+    """The recurring full-bench killer (r06-r08): on a host whose flash
+    path would run in Pallas interpret mode, the ring_s32k section
+    pre-checks and records a skip BEFORE building any array or paying
+    any compile — and, exercised through the real _run_section path
+    with a streaming recorder (the bench-stream kill harness), the
+    sections AFTER it still run and flush. BENCH_RING_S32K_FORCE=1
+    disarms the pre-check."""
+    import bench
+    from apex_tpu import monitor
+
+    # this suite runs on CPU (conftest pins it): the pre-check must
+    # decide to skip, and fast — the killer was a multi-minute-to-
+    # unbounded uninterruptible native call
+    t0 = time.time()
+    skip = bench._ring_s32k_precheck()
+    assert skip is not None and "interpret" in skip
+    assert time.time() - t0 < 10
+
+    p = str(tmp_path / "s.jsonl")
+    rec = monitor.Recorder(name="t", traced_hooks=False, stream=p)
+    data = bench._run_section(rec, "ring_s32k",
+                              bench._bench_ring_s32k_guarded, 30)
+    assert "ring_s32k_skipped" in data, data
+    after = bench._run_section(rec, "after", lambda: {"k": 1}, 30)
+    assert after == {"k": 1}
+    rec.close()
+    with open(p) as f:
+        events = [json.loads(ln) for ln in f.read().splitlines()]
+    names = [e["name"] for e in events if e["kind"] == "section"]
+    assert names == ["ring_s32k", "after"]
+    # the skip row is bookkeeping, not a metric: regress must not read
+    # it as evidence
+    from apex_tpu.monitor import regress
+    assert "ring_s32k_skipped" not in regress._numeric_metrics(data)
+
+    # FORCE disarms the pre-check (the knob for deliberately pricing
+    # interpret mode under an external kill)
+    os.environ["BENCH_RING_S32K_FORCE"] = "1"
+    try:
+        assert bench._ring_s32k_precheck() is None
+    finally:
+        del os.environ["BENCH_RING_S32K_FORCE"]
