@@ -5,17 +5,26 @@ lint step slots into CI as-is (``scripts/lint.sh``).
 
 ``--jaxpr`` runs the full traced layer: the collective-axis consistency
 check, the APXJ101-105 semantic analyzers
-(:mod:`apex_tpu.lint.semantic`), and — unless ``--entrypoint`` narrows
-the run to specific entrypoints — the APXR201-204 rules-table
-validation (:mod:`apex_tpu.lint.rules_tables`). ``--entrypoint NAME``
-(repeatable) restricts the traced gate to the named entrypoints so
-local iteration on one step does not pay for tracing all of them.
+(:mod:`apex_tpu.lint.semantic`), the APXJ106-107 divergence analyzers
+(:mod:`apex_tpu.lint.divergence`), the APXP301-305 precision-flow
+analyzers (:mod:`apex_tpu.lint.precision`), and — unless
+``--entrypoint`` narrows the run to specific entrypoints — the
+APXR201-204 rules-table validation
+(:mod:`apex_tpu.lint.rules_tables`). ``--entrypoint NAME`` (repeatable)
+restricts the traced gate to the named entrypoints so local iteration
+on one step does not pay for tracing all of them.
 
 ``--baseline REPORT.json`` makes the run differential: findings already
 present in the baseline report (matched on ``(code, path, message)`` —
 line numbers drift, messages carry the specifics) are tolerated, and
 the exit status reflects NEW findings only. This is how
 ``scripts/ci.sh`` gates PRs against the committed ``lint_report.json``.
+
+``--format`` picks the output renderer: ``text`` (default), ``json``
+(alias: ``--json``), ``github`` (GitHub Actions ``::error`` workflow
+annotations, so gating findings land on the PR diff), or ``sarif``
+(SARIF 2.1.0 for code-scanning upload). github/sarif render the
+findings that GATE — i.e. post-baseline new findings.
 """
 
 from __future__ import annotations
@@ -34,11 +43,18 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m apex_tpu.lint",
         description="Static analysis for TPU/JAX correctness invariants "
                     "(AST rules APX001-APX007, traced jaxpr analyzers "
-                    "APXJ101-APXJ105, rules-table checks APXR201-APXR204).")
+                    "APXJ101-APXJ107 + precision-flow APXP301-APXP305, "
+                    "rules-table checks APXR201-APXR204).")
     p.add_argument("paths", nargs="*", default=["apex_tpu"],
                    help="files or directories to lint (default: apex_tpu)")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="machine-readable findings on stdout")
+                   help="machine-readable findings on stdout "
+                        "(alias for --format json)")
+    p.add_argument("--format", default=None, dest="fmt",
+                   choices=("text", "json", "github", "sarif"),
+                   help="output renderer: text (default), json, github "
+                        "(::error workflow annotations for PR diffs), "
+                        "or sarif (SARIF 2.1.0)")
     p.add_argument("--select", default=None,
                    help="comma-separated rule codes to run (default: all)")
     p.add_argument("--jaxpr", action="store_true",
@@ -72,6 +88,93 @@ def _failure_key(name: str, problem) -> tuple:
     return (name, str(problem))
 
 
+def _gh_escape(s: str) -> str:
+    """GitHub workflow-command data escaping (%, CR, LF)."""
+    return (str(s).replace("%", "%25")
+            .replace("\r", "%0D").replace("\n", "%0A"))
+
+
+def github_lines(payload: dict) -> list:
+    """Render a ``--json`` payload (or the committed artifact) as GitHub
+    Actions ``::error`` workflow annotations — the findings that gate,
+    i.e. ``new_findings`` when the run was differential, everything
+    otherwise. Findings on real files carry file/line/col so they land
+    on the PR diff; traced pseudo-paths (``<entrypoint:...>``) become
+    file-less annotations."""
+    findings = payload.get("new_findings", payload.get("findings", []))
+    failures = payload.get("new_jaxpr_failures",
+                           payload.get("jaxpr_failures", {}))
+    lines = []
+    for f in findings:
+        path, line = f.get("path", ""), int(f.get("line", 0) or 0)
+        code, msg = f.get("code", ""), _gh_escape(f.get("message", ""))
+        if line > 0 and not path.startswith("<"):
+            col = max(int(f.get("col", 0) or 0), 1)
+            lines.append(f"::error file={_gh_escape(path)},line={line},"
+                         f"col={col},title={code}::{msg}")
+        else:
+            lines.append(f"::error title={code} "
+                         f"{_gh_escape(path)}::{msg}")
+    for name, bad in sorted(failures.items()):
+        lines.append(f"::error title=apexlint entrypoint {name}::"
+                     f"collective-axis check failed: {_gh_escape(bad)}")
+    return lines
+
+
+def sarif_payload(payload: dict) -> dict:
+    """A minimal SARIF 2.1.0 document from a ``--json`` payload: one
+    run, one result per gating finding/failure."""
+    findings = payload.get("new_findings", payload.get("findings", []))
+    failures = payload.get("new_jaxpr_failures",
+                           payload.get("jaxpr_failures", {}))
+    results = []
+    rule_ids: dict = {}
+    for f in findings:
+        code = f.get("code", "APX000")
+        rule_ids.setdefault(code, None)
+        results.append({
+            "ruleId": code,
+            "level": "error",
+            "message": {"text": f.get("message", "")},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.get("path", "")},
+                    "region": {
+                        "startLine": max(int(f.get("line", 0) or 0), 1),
+                        "startColumn": max(int(f.get("col", 0) or 0), 1),
+                    },
+                },
+            }],
+        })
+    for name, bad in sorted(failures.items()):
+        rule_ids.setdefault("APXJ000", None)
+        results.append({
+            "ruleId": "APXJ000",
+            "level": "error",
+            "message": {"text": f"entrypoint {name}: collective-axis "
+                                f"check failed: {bad}"},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f"<entrypoint:{name}>"},
+                    "region": {"startLine": 1, "startColumn": 1},
+                },
+            }],
+        })
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "apexlint",
+                "informationUri": "docs/lint.md",
+                "rules": [{"id": c} for c in sorted(rule_ids)],
+            }},
+            "results": results,
+        }],
+    }
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
@@ -82,9 +185,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         for code, rule in sorted(RULES.items()):
             print(f"{code}  {rule.name}: {rule.description}")
         from apex_tpu.lint import rules_tables, semantic
-        for code in semantic.CODES + rules_tables.CODES:
+        for code in semantic.all_jaxpr_codes() + rules_tables.CODES:
             print(f"{code}  (jaxpr/rules-table layer: see docs/lint.md)")
         return 0
+
+    fmt = args.fmt or ("json" if args.as_json else "text")
 
     select = ([c.strip() for c in args.select.split(",") if c.strip()]
               if args.select else None)
@@ -104,8 +209,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     jaxpr_failures: dict = {}
     entrypoints_analyzed: list = []
     rules_tables_checked: list = []
+    jaxpr_analyzers: list = []
     if args.jaxpr:
         from apex_tpu.lint import rules_tables, semantic
+        jaxpr_analyzers = sorted(semantic.all_jaxpr_codes())
         try:
             res = semantic.run_entrypoint_analyses(names=args.entrypoint)
         except KeyError as e:
@@ -142,22 +249,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         new_jaxpr_failures = {k: v for k, v in jaxpr_failures.items()
                               if _failure_key(k, v) not in known_fail}
 
-    if args.as_json:
-        payload = {
-            "findings": [f.to_json() for f in findings],
-            "jaxpr_failures": {k: sorted(v) if isinstance(v, set) else v
-                               for k, v in jaxpr_failures.items()},
-        }
-        if args.jaxpr:
-            payload["entrypoints_analyzed"] = entrypoints_analyzed
-            payload["rules_tables_checked"] = rules_tables_checked
-        if args.baseline:
-            payload["baseline"] = args.baseline
-            payload["new_findings"] = [f.to_json() for f in new_findings]
-            payload["new_jaxpr_failures"] = {
-                k: sorted(v) if isinstance(v, set) else v
-                for k, v in new_jaxpr_failures.items()}
+    payload = {
+        "findings": [f.to_json() for f in findings],
+        "jaxpr_failures": {k: sorted(v) if isinstance(v, set) else v
+                           for k, v in jaxpr_failures.items()},
+    }
+    if args.jaxpr:
+        payload["entrypoints_analyzed"] = entrypoints_analyzed
+        payload["rules_tables_checked"] = rules_tables_checked
+        payload["jaxpr_analyzers"] = jaxpr_analyzers
+    if args.baseline:
+        payload["baseline"] = args.baseline
+        payload["new_findings"] = [f.to_json() for f in new_findings]
+        payload["new_jaxpr_failures"] = {
+            k: sorted(v) if isinstance(v, set) else v
+            for k, v in new_jaxpr_failures.items()}
+
+    if fmt == "json":
         json.dump(payload, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    elif fmt == "github":
+        for line in github_lines(payload):
+            print(line)
+    elif fmt == "sarif":
+        json.dump(sarif_payload(payload), sys.stdout, indent=2)
         sys.stdout.write("\n")
     else:
         for f in findings:

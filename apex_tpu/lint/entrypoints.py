@@ -735,6 +735,101 @@ def _fused_lm_head_ce():
     return fn, (x, emb, tgt), mesh.axis_names
 
 
+def _amp_o2_master_step():
+    """The O2 master-weight hot loop (``amp.initialize(opt_level="O2")``
+    + FusedAdam): bf16 model casts with fp32 output recast, fp32
+    masters inside the optimizer, dynamic loss scaling with the
+    overflow-skip cond — the program whose contracts the APXP30x
+    precision analyzers gate (fp32 accumulation of the loss reduction,
+    unscale-before-apply, skip=found_inf guarding the master write)."""
+    import jax.numpy as jnp
+    from apex_tpu import amp
+    from apex_tpu.amp import scaler as scaler_mod
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer import parallel_state as ps
+
+    _mesh_for()
+
+    def apply_fn(p, x):
+        return jnp.tanh(x @ p["w1"]) @ p["w2"]
+
+    opt = FusedAdam(lr=1e-3)
+    model, opt = amp.initialize(apply_fn, opt, opt_level="O2",
+                                half_dtype=jnp.bfloat16,
+                                loss_scale="dynamic", verbosity=0)
+
+    def loss_fn(params, x, y):
+        # AmpModel O2: params/inputs cast to bf16, outputs recast to
+        # fp32 BEFORE this mean — the APXP301 contract by construction
+        return jnp.mean((model.apply_fn(params, x) - y) ** 2)
+
+    step = amp.make_train_step(loss_fn, opt, donate=False)
+    params = {"w1": jnp.zeros((4, 8), jnp.float32),
+              "w2": jnp.zeros((8, 2), jnp.float32)}
+    opt_state = opt.init(params)
+    sstate = scaler_mod.init_state()
+    x = jnp.zeros((2, 4), jnp.float32)
+    y = jnp.zeros((2, 2), jnp.float32)
+    allowed = (ps.DATA_AXIS, ps.PIPELINE_AXIS, ps.TENSOR_AXIS,
+               ps.CONTEXT_AXIS, ps.EXPERT_AXIS)
+    return step, (params, opt_state, sstate, x, y), allowed
+
+
+def _pp_1f1b_model_step():
+    """The model-aware 1F1B schedule with its single-rank embed/head
+    conds: embed_fn and loss_fn run under ``lax.cond`` branches taken
+    by exactly one pipeline rank (predicates from ``axis_index`` over
+    the pipeline axis), and the loss head performs a TENSOR-axis psum
+    *inside* its cond — the vocab-parallel loss idiom and the
+    known-hard APXJ106 true negative: the predicate is uniform over the
+    tensor axis, so the tensor group is complete inside the branch,
+    while a pipeline-axis collective in there would deadlock (which is
+    exactly what APXJ106 + the runtime debug_axis_probe reject)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from apex_tpu._compat import shard_map
+    from apex_tpu.transformer import parallel_state as ps
+    from apex_tpu.transformer.pipeline_parallel.schedules import (
+        forward_backward_pipelining_1f1b_model)
+
+    mesh, _, _ = _mesh_for(tp=2, pp=2)
+    nmb = 4
+
+    def embed_fn(ep, mb):
+        return mb * 1.0
+
+    def stage_fn(w, h):
+        return jnp.tanh(h * w["s"])
+
+    def loss_fn(hp, h, mb):
+        # tensor-axis reduction inside the single-rank head cond; the
+        # microbatch keeps the reduced value loop-variant and the
+        # square keeps its BACKWARD loop-variant too (a loss linear in
+        # the psum would transpose to a collective over the constant
+        # cotangent seed — a true APXJ102 on the toy, unlike any real
+        # nonlinear loss head)
+        r = jax.lax.psum((h * mb).astype(jnp.float32), ps.TENSOR_AXIS)
+        return jnp.sum(r * r)
+
+    def run(x, w):
+        loss, grads = forward_backward_pipelining_1f1b_model(
+            embed_fn, stage_fn, loss_fn,
+            {"embed": {}, "stage": {"s": w}, "head": {}}, x, nmb)
+        fp = loss + sum(jnp.sum(leaf.astype(jnp.float32))
+                        for leaf in jax.tree_util.tree_leaves(grads))
+        # per-rank loss/grads -> cross-rank-invariant fingerprint
+        # (APXJ101: P() outputs must not still vary over manual axes)
+        return jax.lax.psum(jax.lax.psum(fp, ps.PIPELINE_AXIS),
+                            ps.TENSOR_AXIS)
+
+    fn = shard_map(run, mesh=mesh, in_specs=(P(), P("pipeline")),
+                   out_specs=P(), check_vma=False)
+    x = jnp.ones((nmb, 2, 4), jnp.float32)
+    w = jnp.ones((mesh.shape[ps.PIPELINE_AXIS],), jnp.float32)
+    return fn, (x, w), mesh.axis_names
+
+
 register_entrypoint("amp_train_step", _amp_train_step)
 register_entrypoint("amp_train_step_monitored", _amp_train_step_monitored)
 register_entrypoint("tensor_parallel_layers", _tensor_parallel_layers)
@@ -754,3 +849,5 @@ register_entrypoint("memory_profiled_step", _memory_profiled_step)
 register_entrypoint("serve_decode_step", _serve_decode_step)
 register_entrypoint("serve_prefill_step", _serve_prefill_step)
 register_entrypoint("fused_lm_head_ce", _fused_lm_head_ce)
+register_entrypoint("amp_o2_master_step", _amp_o2_master_step)
+register_entrypoint("pp_1f1b_model_step", _pp_1f1b_model_step)

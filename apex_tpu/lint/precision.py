@@ -1,0 +1,526 @@
+"""apexlint layer 2c: precision-flow analyzers APXP301-APXP305.
+
+The amp contracts (PAPER.md §apex.amp: per-op cast policy, master
+weights, dynamic loss scaling, fp8 delayed scaling) are *dataflow*
+properties: where a value's dtype narrows, whether a gradient passed
+through the unscale before the optimizer consumed it, whether an
+overflow flag guards the master-weight write. This module checks them
+statically with an abstract interpreter over traced jaxprs: a per-value
+dtype lattice (read straight off the avals — the entrypoint signature
+seeds it) plus taint facts, propagated through ``scan``/``while``/
+``cond``/``pjit``/``custom_vjp`` sub-jaxprs exactly like the variance
+analysis in :mod:`apex_tpu.lint.semantic`.
+
+Taint seeds come from the ``apx:`` profile-scope vocabulary the amp and
+zero hot loops already stamp on their phases (``monitor.profile.scope``
+— ``amp_grad``/``amp_unscale``/``amp_optimizer`` and the ``zero_*``
+twins): the same metadata that powers per-module cost attribution
+doubles as the type-checker's phase labels, so the analyzers see the
+*recipe* (grad -> unscale -> guarded update), not just raw eqns.
+
+- **APXP301 low-precision accumulation chain** — an fp16/bf16
+  ``dot_general`` or ``reduce_sum`` whose (still low-precision) result
+  feeds another low-precision ``reduce_sum`` with no fp32 widening in
+  between. One rounded accumulation is the cast policy working; two
+  chained ones silently lose mass (the bf16-mean-of-a-bf16-matmul bug
+  class). The amp O2 contract (outputs recast to fp32 *before* the
+  loss reduction) passes by construction.
+- **APXP302 optimizer consumes loss-scaled gradients** — a value
+  produced under a grad scope (``amp_grad``/``zero_grad``) reaching an
+  optimizer-scope eqn without passing through the unscale phase: the
+  update step is silently ``loss_scale`` times too large. The unscale
+  phase (``amp_unscale``/``zero_unscale``) strips the taint.
+- **APXP303 fp32->lowp->fp32 round-trip cast** — a
+  ``convert_element_type`` to fp16/bf16/fp8 whose ONLY consumer
+  converts straight back to fp32: the mantissa is destroyed and
+  nothing was bought (no op ran at the narrow width, no bytes moved).
+- **APXP304 fp8 dot without amax recording** — the delayed-scaling
+  recipe (``amp/fp8.py``): every quantized operand of an fp8
+  ``dot_general`` must have its pre-quantization source observed by an
+  ``abs -> reduce_max`` (amax) chain, or the scale statistics silently
+  go stale. Checked only in programs that contain an e5m2 value (the
+  backward wire format — i.e. a gradient pass is present); forward-only
+  inference against frozen scales is exempt.
+- **APXP305 unguarded master-weight write on the overflow path** — the
+  program computes an overflow flag (a boolean produced by the unscale
+  phase) and has an optimizer phase, but no ``cond``/``select_n``
+  inside that phase is predicated on the flag: the O2 bitwise-skip
+  contract (overflow steps leave master weights untouched) is not in
+  the program. ``optimizer.apply(..., skip=found_inf)`` passes; an
+  unconditional update fires.
+
+Findings use the standard schema with the ``<entrypoint:NAME>``
+pseudo-path; per-entrypoint ``disable=`` + rationale opt-outs apply as
+for every other jaxpr-layer code.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from apex_tpu.lint.core import Finding
+
+CODES = ("APXP301", "APXP302", "APXP303", "APXP304", "APXP305")
+
+# the profile-scope vocabulary that labels the hot-loop phases
+# (monitor.profile.SCOPE_PREFIX + name); amp and zero stamp their own
+GRAD_SCOPES = ("amp_grad", "zero_grad")
+UNSCALE_SCOPES = ("amp_unscale", "zero_unscale")
+OPTIMIZER_SCOPES = ("amp_optimizer", "zero_update")
+_SCOPE_PREFIX = "apx:"
+
+# taint tags
+_SGRAD = "scaled-grad"       # produced under a grad scope, not yet unscaled
+_OVF = "overflow-flag"       # derived from the unscale phase's found_inf
+_LACC = "lowp-accum"         # result of a low-precision accumulation
+
+_LOWP = ("float16", "bfloat16")
+_FP8 = ("float8_e4m3fn", "float8_e4m3", "float8_e5m2")
+_FP8_BWD = ("float8_e5m2",)
+# reduce_sum at low precision never comes from jnp.sum (which upcasts
+# its accumulator to fp32 internally) — it comes from BACKWARD passes,
+# where the transpose of a broadcast is a reduce_sum at the cotangent's
+# dtype (the classic fp16 bias-grad accumulation bug), and from
+# lax.cumsum, which keeps its operand dtype
+_ACCUM_REDUCES = ("reduce_sum", "cumsum")
+
+# primitives the APXP304 source/observation cones may traverse: shape
+# and scale plumbing that preserves "which tensor is this a view of"
+_CONE_PRIMS = frozenset({
+    "convert_element_type", "transpose", "broadcast_in_dim", "reshape",
+    "squeeze", "expand_dims", "mul", "div", "max", "min", "clamp",
+    "abs", "neg", "copy", "add", "add_any", "sub", "reduce_max",
+})
+
+
+def _finding(code: str, label: str, message: str) -> Finding:
+    return Finding(code=code, path=label, line=0, col=0, message=message)
+
+
+def _as_jaxpr(obj):
+    inner = getattr(obj, "jaxpr", None)
+    if hasattr(inner, "eqns"):
+        return inner
+    return obj if hasattr(obj, "eqns") else None
+
+
+def _sub_jaxprs(eqn):
+    out = []
+    for v in eqn.params.values():
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        for x in vs:
+            j = _as_jaxpr(x)
+            if j is not None:
+                out.append(j)
+    return out
+
+
+def _dtype_str(v) -> str:
+    aval = getattr(v, "aval", None)
+    return str(getattr(aval, "dtype", ""))
+
+
+def _is_bool(v) -> bool:
+    return _dtype_str(v) == "bool"
+
+
+def _reduced_elems(eqn) -> int:
+    """How many elements one output element of a reduce_sum/cumsum
+    accumulates over. Broadcast transposes sum-to-shape in two steps,
+    the second over a size-1 dim — zero additions, not an accumulation.
+    """
+    shape = getattr(getattr(eqn.invars[0], "aval", None), "shape", None)
+    if shape is None:
+        return 2
+    try:
+        if eqn.primitive.name == "cumsum":
+            return int(shape[eqn.params.get("axis", 0)])
+        n = 1
+        for a in eqn.params.get("axes", ()):
+            n *= int(shape[int(a)])
+        return n
+    except (IndexError, TypeError, ValueError):
+        return 2
+
+
+def _eqn_scope(eqn, inherited: str) -> str:
+    """The eqn's name-stack string, or the enclosing one when the eqn
+    was traced out of context (cond branches, transposed sub-traces)."""
+    st = str(getattr(eqn.source_info, "name_stack", "") or "")
+    return st if st else inherited
+
+
+def _in_scopes(stack: str, names: tuple) -> bool:
+    return any(_SCOPE_PREFIX + n in stack for n in names)
+
+
+class _State:
+    """Shared accumulator across the recursive interpretation of one
+    traced program (findings deduped by originating eqn, the
+    whole-program APXP302/305 phase flags)."""
+
+    def __init__(self, label: str):
+        self.label = label
+        self.findings: list = []
+        self.seen: set = set()           # (code, id(eqn)) emission dedupe
+        self.saw_optimizer = False
+        self.saw_overflow = False
+        self.guarded = False             # ovf-predicated cond/select_n
+        self.p302_eqns: set = set()
+
+    def emit(self, code: str, eqn, message: str):
+        key = (code, id(eqn))
+        if key in self.seen:
+            return
+        self.seen.add(key)
+        self.findings.append(_finding(code, self.label, message))
+
+
+def _interp(jaxpr, in_facts: list, scope: str, st: _State) -> list:
+    """Forward taint propagation over one jaxpr: per-var frozensets of
+    taint tags, scan/while carries run to fixpoint (facts only grow, so
+    a bounded loop converges), cond branches union. Returns per-outvar
+    fact sets."""
+    facts: dict = {}
+
+    def get(v):
+        if hasattr(v, "val"):                       # Literal
+            return frozenset()
+        return facts.get(v, frozenset())
+
+    for v, s in zip(jaxpr.invars, in_facts):
+        facts[v] = frozenset(s)
+    for v in jaxpr.constvars:
+        facts[v] = frozenset()
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        est = _eqn_scope(eqn, scope)
+        ins = frozenset().union(*[get(v) for v in eqn.invars]) \
+            if eqn.invars else frozenset()
+        in_grad = _in_scopes(est, GRAD_SCOPES)
+        in_unscale = _in_scopes(est, UNSCALE_SCOPES)
+        in_opt = _in_scopes(est, OPTIMIZER_SCOPES)
+
+        if in_opt:
+            st.saw_optimizer = True
+            if _SGRAD in ins and not st.p302_eqns:
+                st.p302_eqns.add(id(eqn))
+                st.emit(
+                    "APXP302", eqn,
+                    f"optimizer phase consumes a gradient that is still "
+                    f"loss-scaled: a value produced under the "
+                    f"{'/'.join(GRAD_SCOPES)} scope reaches a "
+                    f"{'/'.join(OPTIMIZER_SCOPES)}-scope equation "
+                    f"({name}) with no unscale "
+                    f"({'/'.join(UNSCALE_SCOPES)}) on the path — the "
+                    "update is silently loss_scale times too large; "
+                    "run scaler.unscale before optimizer.apply")
+            if name in ("cond", "select_n") and eqn.invars \
+                    and _OVF in get(eqn.invars[0]):
+                st.guarded = True
+
+        # --- fact transfer ---
+        base = ins
+        if in_unscale:
+            base = base - {_SGRAD}
+        if in_grad:
+            base = base | {_SGRAD}
+
+        if name == "scan":
+            nc = eqn.params["num_consts"]
+            ncar = eqn.params["num_carry"]
+            body = _as_jaxpr(eqn.params["jaxpr"])
+            op = [get(v) for v in eqn.invars]
+            carry = list(op[nc:nc + ncar])
+            for _ in range(8):
+                res = _interp(body, op[:nc] + carry + op[nc + ncar:],
+                              est, st)
+                new_carry = [c | r for c, r in zip(carry, res[:ncar])]
+                if new_carry == carry:
+                    break
+                carry = new_carry
+            res = _interp(body, op[:nc] + carry + op[nc + ncar:], est, st)
+            outs = [c | r for c, r in zip(carry, res[:ncar])] + res[ncar:]
+        elif name == "while":
+            body = _as_jaxpr(eqn.params["body_jaxpr"])
+            nb = eqn.params.get("body_nconsts", 0)
+            ncc = eqn.params.get("cond_nconsts", 0)
+            op = [get(v) for v in eqn.invars]
+            carry = list(op[ncc + nb:])
+            for _ in range(8):
+                res = _interp(body, op[ncc:ncc + nb] + carry, est, st)
+                new_carry = [c | r for c, r in zip(carry, res)]
+                if new_carry == carry:
+                    break
+                carry = new_carry
+            outs = carry
+        elif name == "cond":
+            branches = [_as_jaxpr(b) for b in eqn.params["branches"]]
+            pred = get(eqn.invars[0])
+            op = [get(v) for v in eqn.invars[1:]]
+            outs = None
+            for b in branches:
+                res = [pred | r for r in _interp(b, op, est, st)]
+                outs = res if outs is None else \
+                    [a | b_ for a, b_ in zip(outs, res)]
+        else:
+            sub = next((s for s in _sub_jaxprs(eqn)
+                        if len(s.invars) == len(eqn.invars)), None)
+            if sub is not None and name != "pallas_call":
+                res = _interp(sub, [get(v) for v in eqn.invars], est, st)
+                outs = (res if len(res) == len(eqn.outvars)
+                        else [base] * len(eqn.outvars))
+            else:
+                outs = [base] * len(eqn.outvars)
+
+        for j, v in enumerate(eqn.outvars):
+            if type(v).__name__ == "DropVar":
+                continue
+            out = frozenset(outs[j])
+            if in_unscale:
+                out = out - {_SGRAD}
+                if _is_bool(v):
+                    out = out | {_OVF}
+                    st.saw_overflow = True
+            if in_grad:
+                out = out | {_SGRAD}
+            # the low-precision accumulation lattice rides the avals:
+            # any widening to >= fp32 clears the taint
+            dt = _dtype_str(v)
+            if dt in _LOWP:
+                if name == "dot_general":
+                    out = out | {_LACC}
+                elif name in _ACCUM_REDUCES and _reduced_elems(eqn) > 1:
+                    if _LACC in ins:
+                        st.emit(
+                            "APXP301", eqn,
+                            f"low-precision accumulation chain: a {dt} "
+                            f"{name} consumes a value that is already "
+                            f"the result of a {'/'.join(_LOWP)} "
+                            "dot/reduction, with no fp32 widening in "
+                            "between — chained rounded accumulations "
+                            "silently lose mass; accumulate in fp32 "
+                            "(preferred_element_type or an .astype) "
+                            "before reducing again")
+                    out = out | {_LACC}
+            else:
+                out = out - {_LACC}
+            facts[v] = out
+    return [get(v) for v in jaxpr.outvars]
+
+
+def check_precision_flow(closed, *, label: str = "<jaxpr>") -> list:
+    """APXP301 + APXP302 + APXP305 over one traced program (the shared
+    taint interpretation)."""
+    jaxpr = _as_jaxpr(closed)
+    st = _State(label)
+    _interp(jaxpr, [frozenset() for _ in jaxpr.invars], "", st)
+    if st.saw_optimizer and st.saw_overflow and not st.guarded:
+        st.findings.append(_finding(
+            "APXP305", label,
+            "master weights are written on the overflow-skip path: the "
+            "program computes an overflow flag (unscale phase) and runs "
+            "an optimizer phase, but no cond/select_n inside the "
+            f"{'/'.join(OPTIMIZER_SCOPES)} scope is predicated on that "
+            "flag — an inf/nan step would be applied to the fp32 "
+            "masters, violating the O2 bitwise-skip contract; pass "
+            "skip=found_inf to optimizer.apply (or jnp.where the "
+            "update against it)"))
+    return st.findings
+
+
+# ---------------------------------------------------------------------------
+# APXP303 — fp32 -> lowp -> fp32 round-trip casts
+# ---------------------------------------------------------------------------
+
+def _walk_jaxprs(jaxpr):
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for sub in _sub_jaxprs(eqn):
+            yield from _walk_jaxprs(sub)
+
+
+def check_round_trip_casts(closed, *, label: str = "<jaxpr>") -> list:
+    """APXP303: a narrow-cast whose only consumer widens straight back.
+
+    Checked per jaxpr body (uses are jaxpr-local): the narrow value must
+    have exactly one consumer, that consumer must be a
+    ``convert_element_type`` back to >= fp32, and the value must not
+    escape as a jaxpr output — anything else means the narrow bytes
+    were actually used (an op ran at the narrow width, or they moved
+    over a wire/through an output)."""
+    findings: list = []
+    narrow = _LOWP + _FP8
+    wide = ("float32", "float64")
+    for j in _walk_jaxprs(_as_jaxpr(closed)):
+        uses: dict = {}
+        for eqn in j.eqns:
+            for v in eqn.invars:
+                if not hasattr(v, "val"):
+                    uses.setdefault(v, []).append(eqn)
+        outset = {id(v) for v in j.outvars}
+        for eqn in j.eqns:
+            if eqn.primitive.name != "convert_element_type":
+                continue
+            src, dst = eqn.invars[0], eqn.outvars[0]
+            if _dtype_str(src) not in wide or _dtype_str(dst) not in narrow:
+                continue
+            consumers = uses.get(dst, [])
+            if id(dst) in outset or len(consumers) != 1:
+                continue
+            back = consumers[0]
+            if back.primitive.name == "convert_element_type" \
+                    and _dtype_str(back.outvars[0]) in wide:
+                findings.append(_finding(
+                    "APXP303", label,
+                    f"fp32 -> {_dtype_str(dst)} -> "
+                    f"{_dtype_str(back.outvars[0])} round-trip cast: "
+                    "the narrow value's only consumer converts straight "
+                    "back to full precision — the mantissa is destroyed "
+                    "and nothing was bought (no op ran at the narrow "
+                    "width); drop both casts, or do the narrow work "
+                    "between them"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# APXP304 — fp8 dots must record amax (the delayed-scaling recipe)
+# ---------------------------------------------------------------------------
+
+def _build_graph(top):
+    """Global def/parent maps over every reachable jaxpr, so source
+    cones can cross pjit/sub-jaxpr boundaries: ``defs[var] = (eqn, k)``;
+    ``parent[body_invar] = outer_operand`` for arity-matching
+    sub-jaxprs; ``inner[outvar of a pjit-like eqn] = body outvar``."""
+    defs: dict = {}
+    parent: dict = {}
+    inner: dict = {}
+    has_e5m2 = False
+    fp8_dots: list = []
+    amax_srcs: list = []
+    for j in _walk_jaxprs(top):
+        for eqn in j.eqns:
+            for k, v in enumerate(eqn.outvars):
+                if type(v).__name__ != "DropVar":
+                    defs[v] = (eqn, k)
+                if _dtype_str(v) in _FP8_BWD:
+                    has_e5m2 = True
+            for v in eqn.invars:
+                if _dtype_str(v) in _FP8_BWD:
+                    has_e5m2 = True
+            name = eqn.primitive.name
+            if name == "dot_general":
+                fp8_ops = [v for v in eqn.invars if _dtype_str(v) in _FP8]
+                if fp8_ops:
+                    fp8_dots.append((eqn, fp8_ops))
+            if name == "reduce_max" and eqn.invars:
+                amax_srcs.append(eqn.invars[0])
+            subs = _sub_jaxprs(eqn)
+            sub = next((s for s in subs
+                        if len(s.invars) == len(eqn.invars)), None)
+            if sub is not None:
+                for bi, ov in zip(sub.invars, eqn.invars):
+                    parent[bi] = ov
+                if len(sub.outvars) == len(eqn.outvars):
+                    for ov, bv in zip(eqn.outvars, sub.outvars):
+                        if type(ov).__name__ != "DropVar":
+                            inner[ov] = bv
+    return defs, parent, inner, has_e5m2, fp8_dots, amax_srcs
+
+
+def _cone(v, defs, parent, inner, limit: int = 64):
+    """Backward slice from ``v`` through the value-preserving plumbing
+    primitives: the set of vars the value is 'a view/scaling of'.
+    ``unknown=True`` when the cone escapes to an untraced input."""
+    seen: set = set()
+    unknown = False
+    stack = [v]
+    while stack and len(seen) < limit:
+        cur = stack.pop()
+        if hasattr(cur, "val") or cur in seen:
+            continue
+        seen.add(cur)
+        if cur in inner:
+            stack.append(inner[cur])
+            continue
+        if cur in defs:
+            eqn, _ = defs[cur]
+            if eqn.primitive.name in _CONE_PRIMS:
+                stack.extend(eqn.invars)
+            elif _sub_jaxprs(eqn):
+                # opaque call we did not map: treat as unknown origin
+                unknown = True
+            # any other producer (a dot, an iota, a gather) is a root
+        elif cur in parent:
+            stack.append(parent[cur])
+        else:
+            unknown = True                # top-level invar / constvar
+    if len(seen) >= limit:
+        unknown = True
+    return seen, unknown
+
+
+def check_fp8_amax_recording(closed, *, label: str = "<jaxpr>") -> list:
+    """APXP304: in a program with a backward pass (an e5m2 value exists
+    anywhere — the recipe's gradient wire format), every fp8 operand of
+    every ``dot_general`` must trace back to a source that an
+    ``abs -> reduce_max`` (amax) chain also observes. A quantized dot
+    whose source is never amax-measured starves the delayed-scaling
+    statistics: the scale goes stale and the next overflow is silent.
+    Forward-only programs (frozen scales, inference) are exempt."""
+    top = _as_jaxpr(closed)
+    defs, parent, inner, has_e5m2, fp8_dots, amax_srcs = _build_graph(top)
+    if not has_e5m2 or not fp8_dots:
+        return []
+    observed: set = set()
+    obs_unknown = False
+    for s in amax_srcs:
+        cone, unk = _cone(s, defs, parent, inner)
+        observed |= {id(x) for x in cone}
+        obs_unknown = obs_unknown or unk
+    findings: list = []
+    for eqn, ops in fp8_dots:
+        for v in ops:
+            cone, unknown = _cone(v, defs, parent, inner)
+            if unknown or any(id(x) in observed for x in cone):
+                continue
+            dt = _dtype_str(v)
+            kind = ("backward cotangent" if dt in _FP8_BWD
+                    else "forward operand")
+            findings.append(_finding(
+                "APXP304", label,
+                f"fp8 dot_general {kind} ({dt}) is quantized without "
+                "amax recording: no abs->reduce_max chain observes its "
+                "pre-quantization source, so the delayed-scaling "
+                "statistics never see this tensor — record amax on "
+                "both passes (the amp.fp8 meta-cotangent pattern) or "
+                "the scale goes stale and overflows turn silent"))
+            break                                    # one finding per dot
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# the combined analyzer
+# ---------------------------------------------------------------------------
+
+def analyze_precision(closed, *, label: str = "<jaxpr>",
+                      select: Optional[Iterable[str]] = None) -> list:
+    """All APXP detectors over one traced program (``select`` filters by
+    code; None = all). The dispatch mirrors ``semantic.analyze_jaxpr``.
+    """
+    wanted = set(select) if select is not None else None
+    findings: list = []
+    groups = (
+        (("APXP301", "APXP302", "APXP305"), check_precision_flow),
+        (("APXP303",), check_round_trip_casts),
+        (("APXP304",), check_fp8_amax_recording),
+    )
+    for codes, fn in groups:
+        if wanted is not None and not (set(codes) & wanted):
+            continue
+        found = fn(closed, label=label)
+        if wanted is not None:
+            found = [f for f in found if f.code in wanted]
+        findings.extend(found)
+    return findings

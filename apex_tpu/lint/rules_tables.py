@@ -152,6 +152,39 @@ def validate_table(rules: Sequence, trees: Iterable[Any], *,
     return findings
 
 
+#: Codes the fail-fast constructor path (``match_zero_rules`` /
+#: ``match_serve_rules`` with ``validate=True``) rejects outright:
+#: shadowed rules and bad/non-divisible decisions are always bugs in
+#: the table as written. Dead rules and uncovered leaves (APXR201)
+#: join them only under ``validate="strict"`` — an exploratory tree
+#: legitimately exercises part of a production table.
+CONSTRUCTOR_REJECT = ("APXR202", "APXR203")
+
+
+def constructor_validate(rules: Sequence, trees: Iterable[Any], *,
+                         table_name: str, kind: str,
+                         world: Optional[int] = None,
+                         strict: bool = False) -> None:
+    """Fail-fast entry for the matcher constructors: run
+    :func:`validate_table` against the tree actually being matched and
+    raise ``ValueError`` carrying the finding text when any rejected
+    code fires. This is how a shadowed rule or a non-divisible shard
+    dies at config-build time instead of shipping as silent layout
+    drift."""
+    findings = validate_table(rules, trees, table_name=table_name,
+                              kind=kind, world=world)
+    reject = set(CONSTRUCTOR_REJECT)
+    if strict:
+        reject.add("APXR201")
+    bad = [f for f in findings if f.code in reject]
+    if bad:
+        raise ValueError(
+            f"{table_name}: rules-table validation failed:\n"
+            + "\n".join(f.format() for f in bad)
+            + "\n(pass validate=False to skip validation for "
+              "exploratory tables)")
+
+
 def cross_check_zero_serve(zero_table: Sequence, serve_table: Sequence,
                            tree, *, world: int = GATE_SERVE_WORLD,
                            min_shard_size: Optional[int] = None,

@@ -525,18 +525,33 @@ def check_donation(closed, *, label: str = "<jaxpr>") -> list:
 # the combined analyzer + entrypoint gate
 # ---------------------------------------------------------------------------
 
+def all_jaxpr_codes() -> tuple:
+    """Every code the traced-jaxpr layer can emit (the analyzer roster
+    CI asserts against): the APXJ10x semantic detectors plus the
+    divergence (APXJ106-107) and precision (APXP30x) analyzers."""
+    from apex_tpu.lint import divergence, precision
+    return CODES + divergence.CODES + precision.CODES
+
+
 def analyze_jaxpr(closed, *, label: str = "<jaxpr>",
                   select: Optional[Iterable[str]] = None) -> list:
-    """All APXJ detectors over one traced program. ``select`` filters by
-    code (None = all)."""
+    """All APXJ + APXP detectors over one traced program. ``select``
+    filters by code (None = all)."""
+    from apex_tpu.lint import divergence, precision
+
     wanted = set(select) if select is not None else None
     findings: list = []
-    for code, fn in (("APXJ101", check_unreduced_outputs),
-                     ("APXJ102", check_scan_collectives),
-                     ("APXJ103", check_ppermute_rings),
-                     ("APXJ104", check_donation)):
-        if wanted is not None and code not in wanted \
-                and not (code == "APXJ104" and "APXJ105" in wanted):
+    dispatch = (
+        (("APXJ101",), check_unreduced_outputs),
+        (("APXJ102",), check_scan_collectives),
+        (("APXJ103",), check_ppermute_rings),
+        # one walker covers both donation codes
+        (("APXJ104", "APXJ105"), check_donation),
+        (divergence.CODES, divergence.check_divergent_collectives),
+        (precision.CODES, precision.analyze_precision),
+    )
+    for codes, fn in dispatch:
+        if wanted is not None and not (set(codes) & wanted):
             continue
         found = fn(closed, label=label)
         if wanted is not None:

@@ -82,6 +82,7 @@ def match_serve_rules(
     *,
     axis_name: str = ps.TENSOR_AXIS,
     world: int | None = None,
+    validate: bool | str = True,
 ) -> Any:
     """Pytree of ``PartitionSpec`` matching ``tree``.
 
@@ -91,11 +92,25 @@ def match_serve_rules(
     code path serves the single-chip engine. A sharded leaf whose
     target dim does not divide by ``world`` is an error at rule time,
     not a shard_map crash later.
+
+    ``validate``: run the apexlint APXR table checks
+    (:mod:`apex_tpu.lint.rules_tables`) against THIS tree at
+    config-build time, raising with the finding text on shadowed rules
+    (APXR202) or bad / out-of-range / non-divisible decisions
+    (APXR203). ``"strict"`` additionally rejects dead rules and
+    uncovered leaves (APXR201); ``False`` opts out for exploratory
+    tables.
     """
     rules = tuple(rules)
     parsed = [(rx, _parse_decision(rx, d)) for rx, d in rules]
     w = ps.get_tensor_model_parallel_world_size() if world is None \
         else int(world)
+    if validate:
+        from apex_tpu.lint.rules_tables import constructor_validate
+        constructor_validate(rules, [tree],
+                             table_name="match_serve_rules",
+                             kind="serve", world=max(w, 1),
+                             strict=validate == "strict")
 
     def decide(path, leaf):
         name = "/".join(leaf_path_names(path))

@@ -66,12 +66,20 @@ def match_zero_rules(
     params: Any,
     *,
     min_shard_size: int = DEFAULT_MIN_SHARD_SIZE,
+    validate: bool | str = True,
 ) -> Any:
     """Pytree of python bools (shard this leaf?) matching ``params``.
 
     ``rules``: ordered ``(regex, "shard"|"replicate")`` pairs;
     ``None`` means :data:`DEFAULT_RULES`. Paths are joined with ``/``
     (``{"block_0": {"kernel": ...}}`` -> ``"block_0/kernel"``).
+
+    ``validate``: run the apexlint APXR table checks
+    (:mod:`apex_tpu.lint.rules_tables`) against THIS tree at
+    config-build time, raising with the finding text on shadowed rules
+    (APXR202) or bad decisions (APXR203). ``"strict"`` additionally
+    rejects dead rules and uncovered leaves (APXR201); ``False`` opts
+    out for exploratory tables.
     """
     rules = DEFAULT_RULES if rules is None else tuple(rules)
     for rx, decision in rules:
@@ -79,6 +87,11 @@ def match_zero_rules(
             raise ValueError(
                 f"zero rule ({rx!r}, {decision!r}): decision must be "
                 f"{SHARD!r} or {REPLICATE!r}")
+    if validate:
+        from apex_tpu.lint.rules_tables import constructor_validate
+        constructor_validate(rules, [params],
+                             table_name="match_zero_rules", kind="zero",
+                             strict=validate == "strict")
 
     def decide(path, leaf) -> bool:
         name = "/".join(leaf_path_names(path))

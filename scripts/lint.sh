@@ -42,5 +42,21 @@ n = len(d["findings"]) + len(d["jaxpr_failures"])
 print(f"apexlint: {n} finding(s)" if n else "apexlint: clean")
 EOF
 
+# on failure, also emit GitHub workflow annotations so the findings
+# land on the PR diff when this runs under Actions (no-op locally
+# beyond a few ::error lines)
+if [[ "$rc" != "0" ]]; then
+  python - "$ARTIFACT" <<'EOF'
+import json, sys
+from apex_tpu.lint.cli import github_lines
+try:
+    payload = json.load(open(sys.argv[1]))
+except (OSError, json.JSONDecodeError):
+    payload = {}
+for line in github_lines(payload):
+    print(line)
+EOF
+fi
+
 echo "lint report: $ARTIFACT"
 exit $rc

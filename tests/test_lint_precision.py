@@ -1,0 +1,612 @@
+"""apex_tpu.lint.precision + divergence: the v3 jaxpr-layer analyzers.
+
+Fire/pass pairs for the precision-flow codes (APXP301-305) and the
+cross-rank divergence codes (APXJ106-107), including propagation
+through scan carries and cond branches, the pipeline single-rank-cond
+true negatives (which must pass WITHOUT opt-outs), the per-code
+``disable=`` escape hatch, the constructor-time rules-table validation
+the matchers grew, the github/sarif renderers, and seeded regressions
+through the exact differential invocation ``scripts/ci.sh`` runs.
+"""
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu._compat import shard_map
+from apex_tpu.lint import divergence, precision, semantic
+from apex_tpu.lint.cli import main as cli_main
+from apex_tpu.lint.jaxpr_checks import (ENTRYPOINT_META, ENTRYPOINTS,
+                                        register_entrypoint)
+from apex_tpu.monitor import profile as prof
+
+FIXTURES = Path(__file__).parent / "fixtures" / "lint"
+BASELINE = Path(__file__).parent.parent / "lint_report.json"
+
+f32, bf16 = jnp.float32, jnp.bfloat16
+
+
+def _codes(findings):
+    return sorted(f.code for f in findings)
+
+
+def _mesh(shape=(4, 2), names=("pipeline", "tensor")):
+    devs = np.array(jax.devices())
+    return Mesh(devs.reshape(*shape), names)
+
+
+@pytest.fixture
+def _temp_entrypoint():
+    added = []
+
+    def add(name, builder, **kw):
+        register_entrypoint(name, builder, **kw)
+        added.append(name)
+        return name
+
+    yield add
+    for name in added:
+        ENTRYPOINTS.pop(name, None)
+        ENTRYPOINT_META.pop(name, None)
+
+
+# ---------------------------------------------------------------------------
+# APXP301 — low-precision accumulation
+# ---------------------------------------------------------------------------
+
+_X = jnp.ones((4, 8), bf16)
+_W1 = jnp.ones((8, 8), bf16)
+_B = jnp.ones((8,), bf16)
+_W2 = jnp.ones((8, 2), bf16)
+
+
+def _bf16_net(x, w1, b, w2):
+    h = jnp.dot(x, w1) + b
+    y = jnp.dot(h, w2)
+    return jnp.sum(y.astype(f32))
+
+
+def test_apxp301_fires_on_bf16_bias_grad_reduction():
+    """The classic half-precision bug: the bias cotangent is a
+    sum-to-shape (broadcast transpose = reduce_sum) executed at bf16 —
+    the backward pass accumulates at 8 mantissa bits."""
+    closed = jax.make_jaxpr(jax.grad(_bf16_net, argnums=(2,)))(
+        _X, _W1, _B, _W2)
+    findings = precision.check_precision_flow(closed)
+    assert _codes(findings) == ["APXP301"]
+    assert "accumul" in findings[0].message
+
+
+def test_apxp301_passes_with_fp32_accumulation():
+    def net(x, w1, b, w2):
+        h = (jnp.dot(x, w1) + b).astype(f32)
+        y = jnp.dot(h, w2.astype(f32))
+        return jnp.sum(y)
+
+    closed = jax.make_jaxpr(jax.grad(net, argnums=(2,)))(_X, _W1, _B, _W2)
+    assert precision.check_precision_flow(closed) == []
+
+
+def test_apxp301_propagates_through_scan_carry():
+    """The tainted matmul product enters a scan CARRY; the lowp
+    accumulation (cumsum keeps its operand dtype) happens inside the
+    body — visible only if the carry facts reach a fixpoint."""
+    def run(x, w1):
+        h = jnp.dot(x, w1)
+
+        def body(c, _):
+            c2 = c * bf16(2.0)
+            return c2, jax.lax.cumsum(c2, axis=0)
+
+        return jax.lax.scan(body, h, None, length=3)
+
+    closed = jax.make_jaxpr(run)(_X, _W1)
+    assert _codes(precision.check_precision_flow(closed)) == ["APXP301"]
+
+
+def test_apxp301_propagates_into_cond_branch():
+    def run(x, w1, p):
+        h = jnp.dot(x, w1)
+        return jax.lax.cond(p, lambda v: jax.lax.cumsum(v, axis=0),
+                            lambda v: v, h)
+
+    closed = jax.make_jaxpr(run)(_X, _W1, True)
+    assert _codes(precision.check_precision_flow(closed)) == ["APXP301"]
+
+
+# ---------------------------------------------------------------------------
+# APXP302 / APXP305 — loss-scale handling around the optimizer
+# ---------------------------------------------------------------------------
+
+_XF = jnp.ones((4,), f32)
+
+
+def _step_missing_unscale(p, g_seed):
+    with prof.scope("amp_grad"):
+        g = g_seed * 2.0
+    with prof.scope("amp_optimizer"):
+        return p - 0.1 * g
+
+
+def _step_correct(p, g_seed):
+    with prof.scope("amp_grad"):
+        g = g_seed * 2.0
+    with prof.scope("amp_unscale"):
+        g = g * 0.5
+        found = ~jnp.isfinite(g).all()
+    with prof.scope("amp_optimizer"):
+        new_p = jax.lax.cond(found, lambda p, g: p,
+                             lambda p, g: p - 0.1 * g, p, g)
+    return new_p
+
+
+def _step_unguarded(p, g_seed):
+    with prof.scope("amp_grad"):
+        g = g_seed * 2.0
+    with prof.scope("amp_unscale"):
+        g = g * 0.5
+        found = ~jnp.isfinite(g).all()
+    with prof.scope("amp_optimizer"):
+        new_p = p - 0.1 * g
+    return new_p, found
+
+
+def test_apxp302_fires_once_on_scaled_grad_into_optimizer():
+    closed = jax.make_jaxpr(_step_missing_unscale)(_XF, _XF)
+    findings = precision.check_precision_flow(closed)
+    assert _codes(findings) == ["APXP302"]
+    assert "unscale" in findings[0].message
+
+
+def test_apxp302_apxp305_pass_on_correct_step():
+    closed = jax.make_jaxpr(_step_correct)(_XF, _XF)
+    assert precision.check_precision_flow(closed) == []
+
+
+def test_apxp305_fires_on_unguarded_master_update():
+    """The O2 bitwise-skip contract: an overflow flag is computed but
+    the optimizer-scope update is not gated on it."""
+    closed = jax.make_jaxpr(_step_unguarded)(_XF, _XF)
+    findings = precision.check_precision_flow(closed)
+    assert _codes(findings) == ["APXP305"]
+    assert "overflow" in findings[0].message
+
+
+def test_real_amp_step_is_clean():
+    """The shipped amp train step carries the full grad -> unscale ->
+    guarded-update chain; the analyzer must see it as correct (this is
+    also the non-inertness anchor: the same analyzer DOES fire on the
+    seeded fixtures above)."""
+    from apex_tpu.lint import entrypoints  # noqa: F401 (registers)
+    fn, args, _ = ENTRYPOINTS["amp_train_step"]()
+    closed = jax.make_jaxpr(fn)(*args)
+    assert precision.analyze_precision(closed) == []
+
+
+# ---------------------------------------------------------------------------
+# APXP303 — precision-destroying round trips
+# ---------------------------------------------------------------------------
+
+def test_apxp303_fires_on_pointless_round_trip():
+    closed = jax.make_jaxpr(lambda x: x.astype(bf16).astype(f32) + 1.0)(
+        _XF)
+    findings = precision.check_round_trip_casts(closed)
+    assert _codes(findings) == ["APXP303"]
+    assert "round" in findings[0].message
+
+
+def test_apxp303_passes_when_narrow_value_does_work():
+    def run(x):
+        h = x.astype(bf16)
+        return h.astype(f32) + jnp.sum(h, dtype=f32)
+
+    assert precision.check_round_trip_casts(jax.make_jaxpr(run)(_XF)) == []
+
+
+# ---------------------------------------------------------------------------
+# APXP304 — fp8 backward without amax recording
+# ---------------------------------------------------------------------------
+
+_E4, _E5 = jnp.float8_e4m3fn, jnp.float8_e5m2
+
+
+def _fp8_mm(record_amax):
+    @jax.custom_vjp
+    def mm(x, w):
+        return jnp.dot(x, w)
+
+    def fwd(x, w):
+        return jnp.dot(x.astype(_E4).astype(f32),
+                       w.astype(_E4).astype(f32)), (x.astype(_E4),
+                                                    w.astype(_E4))
+
+    def bwd(res, dy):
+        qx, qw = res
+        if record_amax:
+            amax = jnp.max(jnp.abs(dy))
+            qg = (dy / jnp.maximum(amax, 1e-6)).astype(_E5)
+        else:
+            amax = f32(1.0)
+            qg = dy.astype(_E5)
+        dims = (((1,), (1,)), ((), ()))
+        dx = jax.lax.dot_general(qg, qw, dims,
+                                 preferred_element_type=f32) * amax
+        dims = (((0,), (0,)), ((), ()))
+        dw = jax.lax.dot_general(qx, qg, dims,
+                                 preferred_element_type=f32) * amax
+        return dx, dw
+
+    mm.defvjp(fwd, bwd)
+    return mm
+
+
+def test_apxp304_fires_without_amax_recording():
+    mm = _fp8_mm(record_amax=False)
+    xm = jnp.ones((4, 4), f32)
+    closed = jax.make_jaxpr(
+        jax.grad(lambda x, w: jnp.sum(mm(x, w))))(xm, xm)
+    findings = precision.check_fp8_amax_recording(closed)
+    assert findings and all(f.code == "APXP304" for f in findings)
+    assert "amax" in findings[0].message
+
+
+def test_apxp304_passes_with_amax_recording():
+    mm = _fp8_mm(record_amax=True)
+    xm = jnp.ones((4, 4), f32)
+    closed = jax.make_jaxpr(
+        jax.grad(lambda x, w: jnp.sum(mm(x, w))))(xm, xm)
+    assert precision.check_fp8_amax_recording(closed) == []
+
+
+def test_real_fp8_step_is_clean():
+    from apex_tpu.lint import entrypoints  # noqa: F401 (registers)
+    fn, args, _ = ENTRYPOINTS["fp8_train_step"]()
+    closed = jax.make_jaxpr(fn)(*args)
+    assert precision.analyze_precision(closed) == []
+
+
+# ---------------------------------------------------------------------------
+# APXJ106 — collectives under rank-divergent control flow
+# ---------------------------------------------------------------------------
+
+def test_apxj106_fires_on_deadlocking_cond():
+    """Only rank 0 enters the branch, and the branch psums over the
+    SAME axis the predicate diverges on: ranks 1..3 never post the
+    collective — static deadlock."""
+    mesh = _mesh()
+
+    def run(x):
+        r = jax.lax.axis_index("pipeline")
+        return jax.lax.cond(r == 0,
+                            lambda v: jax.lax.psum(v, "pipeline"),
+                            lambda v: jnp.zeros_like(v), x)
+
+    fn = shard_map(run, mesh=mesh, in_specs=(P("pipeline"),),
+                   out_specs=P("pipeline"), check_vma=False)
+    closed = jax.make_jaxpr(fn)(jnp.ones((4, 2), f32))
+    findings = divergence.check_divergent_collectives(closed)
+    assert _codes(findings) == ["APXJ106"]
+    assert "pipeline" in findings[0].message
+
+
+def test_apxj106_pipeline_single_rank_cond_is_a_true_negative():
+    """The known-hard case the analyzer must NOT flag: the pipeline
+    embed/head idiom — a cond whose predicate diverges on the pipeline
+    axis but whose collective runs over the tensor axis, which every
+    rank entering the branch shares."""
+    mesh = _mesh()
+
+    def run(x):
+        r = jax.lax.axis_index("pipeline")
+        return jax.lax.cond(r == 3,
+                            lambda v: jax.lax.psum(v, "tensor"),
+                            lambda v: jnp.zeros_like(v), x)
+
+    fn = shard_map(run, mesh=mesh, in_specs=(P("pipeline"),),
+                   out_specs=P("pipeline"), check_vma=False)
+    closed = jax.make_jaxpr(fn)(jnp.ones((4, 2), f32))
+    assert divergence.check_divergent_collectives(closed) == []
+
+
+def test_apxj106_fires_inside_rank_divergent_while():
+    """Each rank runs a different trip count, and the BODY posts a
+    collective over the diverging axis: rank 0 exits immediately while
+    rank 3 still waits on it."""
+    mesh = _mesh()
+
+    def run(x):
+        r = jax.lax.axis_index("pipeline")
+
+        def cond(c):
+            return c[0] < r
+
+        def body(c):
+            i, v = c
+            return i + 1, jax.lax.psum(v, "pipeline")
+
+        return jax.lax.while_loop(cond, body, (0, x))[1]
+
+    fn = shard_map(run, mesh=mesh, in_specs=(P("pipeline"),),
+                   out_specs=P("pipeline"), check_vma=False)
+    closed = jax.make_jaxpr(fn)(jnp.ones((4, 2), f32))
+    assert _codes(divergence.check_divergent_collectives(closed)) == \
+        ["APXJ106"]
+
+
+def test_apxj106_passes_on_uniform_predicate():
+    mesh = _mesh()
+
+    def run(x, p):
+        return jax.lax.cond(p, lambda v: jax.lax.psum(v, "tensor"),
+                            lambda v: jnp.zeros_like(v), x)
+
+    fn = shard_map(run, mesh=mesh, in_specs=(P("pipeline"), P()),
+                   out_specs=P("pipeline"), check_vma=False)
+    closed = jax.make_jaxpr(fn)(jnp.ones((4, 2), f32), jnp.array(True))
+    assert divergence.check_divergent_collectives(closed) == []
+
+
+def test_apxj106_real_pipeline_entrypoints_pass_without_optouts():
+    """The shipped pipeline schedules carry the single-rank embed/head
+    conds and the zero-bubble wgrad flush — the acceptance true
+    negatives. They must analyze clean with NO disable= entries for
+    the divergence codes."""
+    names = ["pipeline_schedule", "pp_zero_bubble_step",
+             "pp_1f1b_model_step"]
+    for name in names:
+        disabled = ENTRYPOINT_META.get(name, {}).get("disable",
+                                                     frozenset())
+        assert not (set(disabled) & set(divergence.CODES)), name
+    res = semantic.run_entrypoint_analyses(names=names)
+    assert res["axis_failures"] == {}
+    div = [f for f in res["findings"] if f.code in divergence.CODES]
+    assert div == [], [f.format() for f in div]
+
+
+# ---------------------------------------------------------------------------
+# APXJ107 — branch-dependent collective sets
+# ---------------------------------------------------------------------------
+
+def test_apxj107_fires_on_mismatched_branch_collectives():
+    mesh = _mesh((2, 2, 2), ("data", "pipeline", "tensor"))
+
+    def run(x):
+        r = jax.lax.axis_index("pipeline")
+        return jax.lax.cond(r == 0,
+                            lambda v: jax.lax.psum(v, "tensor"),
+                            lambda v: jax.lax.psum(v, "data"), x)
+
+    fn = shard_map(run, mesh=mesh, in_specs=(P("pipeline"),),
+                   out_specs=P("pipeline"), check_vma=False)
+    closed = jax.make_jaxpr(fn)(jnp.ones((2, 2), f32))
+    findings = divergence.check_divergent_collectives(closed)
+    assert "APXJ107" in _codes(findings)
+
+
+def test_apxj107_one_sided_guarded_collective_is_exempt():
+    """One branch communicates, the other is pure compute: the guarded
+    -collective pipeline idiom, APXJ106's territory (and clean here
+    because the axes don't intersect the divergence)."""
+    mesh = _mesh()
+
+    def run(x):
+        r = jax.lax.axis_index("pipeline")
+        return jax.lax.cond(r == 0,
+                            lambda v: jax.lax.psum(v, "tensor"),
+                            lambda v: jnp.zeros_like(v), x)
+
+    fn = shard_map(run, mesh=mesh, in_specs=(P("pipeline"),),
+                   out_specs=P("pipeline"), check_vma=False)
+    closed = jax.make_jaxpr(fn)(jnp.ones((4, 2), f32))
+    assert divergence.check_divergent_collectives(closed) == []
+
+
+# ---------------------------------------------------------------------------
+# per-code disable= opt-outs for the new analyzers
+# ---------------------------------------------------------------------------
+
+def _seeded_p301_builder():
+    fn = jax.grad(_bf16_net, argnums=(2,))
+    return fn, (_X, _W1, _B, _W2), ()
+
+
+def _seeded_j106_builder():
+    mesh = _mesh()
+
+    def run(x):
+        r = jax.lax.axis_index("pipeline")
+        return jax.lax.cond(r == 0,
+                            lambda v: jax.lax.psum(v, "pipeline"),
+                            lambda v: jnp.zeros_like(v), x)
+
+    fn = shard_map(run, mesh=mesh, in_specs=(P("pipeline"),),
+                   out_specs=P("pipeline"), check_vma=False)
+    return fn, (jnp.ones((4, 2), f32),), mesh.axis_names
+
+
+@pytest.mark.parametrize("builder,code", [
+    (_seeded_p301_builder, "APXP301"),
+    (_seeded_j106_builder, "APXJ106"),
+])
+def test_new_codes_honor_per_entrypoint_disable(_temp_entrypoint,
+                                                builder, code):
+    name = _temp_entrypoint(f"_tmp_{code.lower()}", builder)
+    res = semantic.run_entrypoint_analyses(names=[name])
+    assert [f.code for f in res["findings"]] == [code]
+
+    ENTRYPOINTS.pop(name)
+    ENTRYPOINT_META.pop(name)
+    _temp_entrypoint(name, builder, disable=(code,),
+                     rationale="test fixture: known and accepted")
+    res = semantic.run_entrypoint_analyses(names=[name])
+    assert res["findings"] == []
+
+
+# ---------------------------------------------------------------------------
+# seeded regressions through the exact ci.sh differential invocation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("builder,code", [
+    (_seeded_p301_builder, "APXP301"),
+    (_seeded_j106_builder, "APXJ106"),
+])
+def test_seeded_bug_fails_differential_gate(_temp_entrypoint, capsys,
+                                            builder, code):
+    name = _temp_entrypoint(f"_tmp_gate_{code.lower()}", builder)
+    rc = cli_main([str(FIXTURES / "apx001_clean.py"), "--jaxpr",
+                   "--entrypoint", name, "--json",
+                   "--baseline", str(BASELINE)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [f["code"] for f in payload["new_findings"]] == [code]
+    assert code[:4] in ("APXP", "APXJ")
+    assert code in payload["jaxpr_analyzers"]
+
+
+def test_cli_select_narrows_to_new_codes(_temp_entrypoint, capsys):
+    name = _temp_entrypoint("_tmp_select_p301", _seeded_p301_builder)
+    rc = cli_main([str(FIXTURES / "apx001_clean.py"), "--jaxpr",
+                   "--entrypoint", name, "--select", "APXJ106",
+                   "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0 and payload["findings"] == []
+    rc = cli_main([str(FIXTURES / "apx001_clean.py"), "--jaxpr",
+                   "--entrypoint", name, "--select", "APXP301",
+                   "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert [f["code"] for f in payload["findings"]] == ["APXP301"]
+
+
+# ---------------------------------------------------------------------------
+# --format github / sarif
+# ---------------------------------------------------------------------------
+
+def test_cli_format_github_annotations(_temp_entrypoint, capsys):
+    name = _temp_entrypoint("_tmp_gh", _seeded_p301_builder)
+    rc = cli_main([str(FIXTURES / "apx001_clean.py"), "--jaxpr",
+                   "--entrypoint", name, "--format", "github"])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 1
+    assert out and all(line.startswith("::error ") for line in out)
+    assert any("APXP301" in line for line in out)
+
+
+def test_cli_format_github_is_differential(_temp_entrypoint, capsys,
+                                           tmp_path):
+    """Baselined findings must emit NO annotations — github mode
+    renders what gates, not what exists."""
+    name = _temp_entrypoint("_tmp_gh_diff", _seeded_p301_builder)
+    args = [str(FIXTURES / "apx001_clean.py"), "--jaxpr",
+            "--entrypoint", name]
+    rc = cli_main(args + ["--json"])
+    base = tmp_path / "base.json"
+    base.write_text(capsys.readouterr().out)
+    assert rc == 1
+    rc = cli_main(args + ["--baseline", str(base), "--format", "github"])
+    assert rc == 0
+    assert capsys.readouterr().out.strip() == ""
+
+
+def test_cli_format_sarif(_temp_entrypoint, capsys):
+    name = _temp_entrypoint("_tmp_sarif", _seeded_p301_builder)
+    rc = cli_main([str(FIXTURES / "apx001_clean.py"), "--jaxpr",
+                   "--entrypoint", name, "--format", "sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "apexlint"
+    assert [r["ruleId"] for r in run["results"]] == ["APXP301"]
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == \
+        {"APXP301"}
+
+
+def test_github_escaping():
+    from apex_tpu.lint.cli import _gh_escape
+    assert _gh_escape("a%b\r\nc") == "a%25b%0D%0Ac"
+
+
+# ---------------------------------------------------------------------------
+# constructor-time rules-table validation (match_* validate= kwarg)
+# ---------------------------------------------------------------------------
+
+def test_match_zero_rules_rejects_shadowed_table():
+    from apex_tpu.zero import rules as zero_rules
+    params = {"w": jnp.ones((64,), f32), "bias": jnp.ones((64,), f32)}
+    table = ((".*", "shard"), ("bias", "replicate"))
+    with pytest.raises(ValueError, match="shadowed"):
+        zero_rules.match_zero_rules(table, params, min_shard_size=1)
+    got = zero_rules.match_zero_rules(table, params, min_shard_size=1,
+                                      validate=False)
+    assert got == {"w": True, "bias": True}
+
+
+def test_match_zero_rules_strict_rejects_dead_rule():
+    from apex_tpu.zero import rules as zero_rules
+    params = {"w": jnp.ones((64,), f32)}
+    table = (("qkv_packed", "replicate"), (".*", "shard"))
+    got = zero_rules.match_zero_rules(table, params, min_shard_size=1)
+    assert got == {"w": True}          # dead rules pass by default
+    with pytest.raises(ValueError, match="dead rule"):
+        zero_rules.match_zero_rules(table, params, min_shard_size=1,
+                                    validate="strict")
+
+
+def test_match_serve_rules_rejects_bad_shard_dims():
+    from apex_tpu.serve import rules as serve_rules
+    tree = {"x": np.zeros((3, 4))}
+    with pytest.raises(ValueError, match="not divisible"):
+        serve_rules.match_serve_rules(((".*", "shard:0"),), tree,
+                                      world=2)
+    with pytest.raises(ValueError, match="dim"):
+        serve_rules.match_serve_rules(((".*", "shard:7"),), tree,
+                                      world=2)
+    specs = serve_rules.match_serve_rules(((".*", "shard:1"),), tree,
+                                          world=2)
+    assert specs["x"] == P(None, "tensor")
+
+
+def test_match_serve_rules_rejects_shadowed_table():
+    from apex_tpu.serve import rules as serve_rules
+    tree = {"x": np.zeros((4, 4))}
+    table = ((".*", "replicate"), ("x", "shard:0"))
+    with pytest.raises(ValueError, match="shadowed"):
+        serve_rules.match_serve_rules(table, tree, world=2)
+    specs = serve_rules.match_serve_rules(table, tree, world=2,
+                                          validate=False)
+    assert specs["x"] == P()
+
+
+def test_validation_error_carries_finding_text():
+    from apex_tpu.zero import rules as zero_rules
+    params = {"bias": jnp.ones((64,), f32)}
+    table = ((".*", "shard"), ("bias", "replicate"))
+    with pytest.raises(ValueError) as exc:
+        zero_rules.match_zero_rules(table, params, min_shard_size=1)
+    msg = str(exc.value)
+    assert "APXR202" in msg and "validate=False" in msg
+
+
+# ---------------------------------------------------------------------------
+# catalog plumbing
+# ---------------------------------------------------------------------------
+
+def test_all_jaxpr_codes_exposes_new_analyzers():
+    codes = semantic.all_jaxpr_codes()
+    for c in ("APXJ106", "APXJ107", "APXP301", "APXP302", "APXP303",
+              "APXP304", "APXP305"):
+        assert c in codes
+
+
+def test_list_rules_includes_new_codes(capsys):
+    rc = cli_main(["--list-rules"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for c in ("APXP301", "APXP305", "APXJ106", "APXJ107"):
+        assert c in out
