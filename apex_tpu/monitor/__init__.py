@@ -28,7 +28,13 @@ memory surface (``monitor.memory``: compiled-footprint attribution,
 the analytic high-water walk charged per ``apx:`` scope, the live
 :class:`MemorySampler` HBM timeline, ZeRO/serve capacity reports and
 the tuner's ``vmem_calibration`` feedback loop,
-``python -m apex_tpu.monitor memory``), and a CLI report
+``python -m apex_tpu.monitor memory``), a crash-safe flight recorder
+(``monitor.flight``: SIGTERM/SIGINT/atexit/fatal-watchdog triggers dump
+the ring tail + open-span stack atomically to rank-tagged
+``flight-<rank>.jsonl`` black boxes), a Chrome-trace/Perfetto exporter
+(``monitor.timeline``: shards + flight dumps fused into one cross-rank
+timeline with clock alignment and a straggler overlay,
+``python -m apex_tpu.monitor timeline``), and a CLI report
 (``python -m apex_tpu.monitor report run.jsonl``).
 
 Quick start::
@@ -63,6 +69,7 @@ from __future__ import annotations
 import contextlib
 
 from apex_tpu.monitor import _state
+from apex_tpu.monitor import flight  # noqa: F401
 from apex_tpu.monitor import health  # noqa: F401
 from apex_tpu.monitor import hooks  # noqa: F401
 from apex_tpu.monitor import memory  # noqa: F401
@@ -70,6 +77,7 @@ from apex_tpu.monitor import merge  # noqa: F401
 from apex_tpu.monitor import profile  # noqa: F401
 from apex_tpu.monitor import regress  # noqa: F401
 from apex_tpu.monitor import spans  # noqa: F401
+from apex_tpu.monitor import timeline  # noqa: F401
 from apex_tpu.monitor import trace  # noqa: F401
 from apex_tpu.monitor import xprof  # noqa: F401
 from apex_tpu.monitor.health import Watchdog  # noqa: F401

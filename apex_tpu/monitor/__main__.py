@@ -2,6 +2,8 @@
 
     python -m apex_tpu.monitor report run.jsonl [--json] [--max-rows N]
     python -m apex_tpu.monitor merge SHARD... [--json] [-o OUT.json]
+    python -m apex_tpu.monitor timeline DUMP... [-o trace.json]
+                                       [--no-align] [--validate-only]
     python -m apex_tpu.monitor profile [--model gpt|mlp] [--measured]
     python -m apex_tpu.monitor memory [--model gpt|mlp|zero|serve]
                                       [--live] [--json]
@@ -12,10 +14,18 @@
 ``report`` renders the per-step and aggregate tables from a
 ``Recorder.dump_jsonl`` file (the ``pyprof.prof`` analog — per-step
 training telemetry instead of per-kernel nvprof records). ``merge``
-combines rank-tagged shards (``monitor-<rank>.jsonl``, or a directory
-holding them) from a multi-process run into one cross-host view:
-collective bytes summed across ranks, per-rank timer distributions
-with straggler percentiles, per-rank step-time skew. ``profile``
+combines rank-tagged shards (``monitor-<rank>.jsonl`` files, glob
+patterns, or a directory holding them; flight dumps work too) from a
+multi-process run into one cross-host view: collective bytes summed
+across ranks, per-rank timer distributions with straggler percentiles,
+per-rank step-time skew — and exits non-zero with a clear message when
+zero shards match. ``timeline`` fuses the same shards and/or crash
+``flight-<rank>.jsonl`` dumps (``apex_tpu.monitor.flight``) into one
+Chrome-trace/Perfetto JSON — span trees, compile events, ``memory/
+hbm_*`` counter tracks, health instants, one process track per rank,
+cross-rank clock alignment on step boundaries, and a per-step
+straggler overlay; open the output in https://ui.perfetto.dev or
+chrome://tracing. ``profile``
 builds a model train step (GPT by default; shape knobs below) and
 prints the per-module cost attribution table — analytic FLOPs/bytes
 per profile scope, optionally merged with measured eager wall times
@@ -53,6 +63,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -71,12 +82,29 @@ def main(argv=None) -> int:
                         help="merge rank-tagged shards into a "
                              "cross-host report")
     pm.add_argument("shards", nargs="+",
-                    help="monitor-<rank>.jsonl files, or one directory "
-                         "containing them")
+                    help="monitor-<rank>.jsonl files, glob patterns, "
+                         "or one directory containing shards")
     pm.add_argument("--json", action="store_true",
                     help="print the merged view as JSON")
     pm.add_argument("-o", "--out", default=None,
                     help="also write the merged JSON here")
+
+    pt = sub.add_parser("timeline",
+                        help="fuse shards/flight dumps into one "
+                             "Chrome-trace (Perfetto) JSON")
+    pt.add_argument("dumps", nargs="+",
+                    help="monitor-<rank>.jsonl / flight-<rank>.jsonl "
+                         "files, glob patterns, or directories")
+    pt.add_argument("-o", "--out", default="trace.json",
+                    help="output trace path (default: trace.json)")
+    pt.add_argument("--no-align", action="store_true",
+                    help="skip cross-rank clock alignment")
+    pt.add_argument("--straggler-ratio", type=float, default=None,
+                    help="per-step slowest/median bar for straggler "
+                         "instants (default 1.5)")
+    pt.add_argument("--validate-only", action="store_true",
+                    help="build + shape-check without writing the "
+                         "trace (the CI gate mode)")
 
     pp = sub.add_parser("profile",
                         help="per-module cost attribution for a model "
@@ -192,10 +220,23 @@ def main(argv=None) -> int:
 
     if args.cmd == "merge":
         from apex_tpu.monitor import merge as merge_mod
-        shards = args.shards
-        if len(shards) == 1:
-            shards = shards[0]   # may be a directory; merge_shards resolves
-        merged = json_safe(merge_mod.merge_shards(shards))
+        from apex_tpu.monitor.timeline import _expand
+        if len(args.shards) == 1 and os.path.isdir(args.shards[0]):
+            shards = args.shards[0]   # directory; merge_shards resolves
+            missing_msg = (f"no monitor shards found: no "
+                           f"monitor-<rank>.jsonl or flight-<rank>."
+                           f"jsonl in directory {args.shards[0]!r}")
+        else:
+            shards = _expand(args.shards)   # globs + files, deduped
+            missing_msg = (f"no monitor shards found: nothing matched "
+                           f"{' '.join(args.shards)!r}")
+        try:
+            merged = json_safe(merge_mod.merge_shards(shards))
+        except ValueError as e:
+            if "no monitor shards" in str(e):
+                print(missing_msg, file=sys.stderr)
+                return 2
+            raise
         if args.out:
             with open(args.out, "w") as f:
                 json.dump(merged, f, indent=2)
@@ -203,6 +244,34 @@ def main(argv=None) -> int:
             print(json.dumps(merged, indent=2))
         else:
             print(report_mod.render_cross_host(merged))
+        return 0
+
+    if args.cmd == "timeline":
+        from apex_tpu.monitor import timeline as timeline_mod
+        sources = timeline_mod.load_sources(args.dumps)
+        if not sources:
+            print(f"no recorder dumps found: nothing matched "
+                  f"{' '.join(args.dumps)!r}", file=sys.stderr)
+            return 2
+        kw = {}
+        if args.straggler_ratio is not None:
+            kw["straggler_ratio"] = args.straggler_ratio
+        trace = timeline_mod.build_timeline(
+            sources, align=not args.no_align, **kw)
+        problems = timeline_mod.validate_timeline(trace)
+        if problems:
+            for pr_ in problems[:20]:
+                print(f"timeline shape error: {pr_}", file=sys.stderr)
+            return 1
+        n_ev = len(trace["traceEvents"])
+        if args.validate_only:
+            print(f"timeline ok: {n_ev} events across "
+                  f"{len(sources)} rank(s) (not written)")
+            return 0
+        timeline_mod.write_timeline(trace, args.out)
+        print(f"timeline: {n_ev} events across {len(sources)} rank(s) "
+              f"-> {args.out} (open in https://ui.perfetto.dev or "
+              f"chrome://tracing)")
         return 0
 
     if args.cmd == "regress":
@@ -230,7 +299,6 @@ def main(argv=None) -> int:
         return _run_memory(args)
 
     # selfcheck needs a backend; default to CPU unless the caller chose
-    import os
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     report_mod.selfcheck(n_steps=args.steps, verbose=not args.quiet)
     return 0

@@ -72,12 +72,23 @@ def _fmt_value(v) -> str:
     return repr(f)
 
 
-def snapshot(recorder=None, events=None) -> dict:
+def snapshot(recorder=None, events=None, header=None) -> dict:
     """One point-in-time metrics snapshot, from a live recorder
     (default: the attached one) or from an event list (the JSONL file
     modes). Shape: ``{counters, gauges, timers, histograms}`` where
-    histograms hold :meth:`LogHistogram.snapshot` payloads."""
+    histograms hold :meth:`LogHistogram.snapshot` payloads.
+
+    Recorder blind spots are exported too, so a saturated ring is
+    itself observable: ``monitor/dropped_events`` (ring evictions →
+    ``apex_monitor_dropped_events_total``) and ``monitor/open_spans``
+    (started-but-unfinished spans → ``apex_monitor_open_spans``) —
+    live from ``Recorder.dropped``/``spans.open_spans()``, file-backed
+    from the dump ``header`` when the caller passes it."""
     if events is not None:
+        if header:
+            return _with_blind_spots(
+                snapshot(events=events),
+                header.get("dropped"), header.get("open_spans"))
         from apex_tpu.monitor.report import aggregate as _aggregate
         counters: dict = {}
         gauges: dict = {}
@@ -102,6 +113,7 @@ def snapshot(recorder=None, events=None) -> dict:
     if rec is None:
         return {"counters": {}, "gauges": {}, "timers": {},
                 "histograms": {}}
+    from apex_tpu.monitor.spans import open_spans
     agg_timers: dict = {}
     for ev in rec.records("timer"):
         t = agg_timers.setdefault(ev.get("name"), {"n": 0, "total_s": 0.0})
@@ -113,10 +125,18 @@ def snapshot(recorder=None, events=None) -> dict:
     # shadow, so drop it for live == file consistency
     counters = {k: v for k, v in rec.counters().items()
                 if not k.endswith("/total_s")}
-    return {"counters": counters, "gauges": rec.gauges(),
-            "timers": agg_timers,
-            "histograms": {k: h.snapshot()
-                           for k, h in rec.histograms().items()}}
+    return _with_blind_spots(
+        {"counters": counters, "gauges": rec.gauges(),
+         "timers": agg_timers,
+         "histograms": {k: h.snapshot()
+                        for k, h in rec.histograms().items()}},
+        rec.dropped, open_spans())
+
+
+def _with_blind_spots(snap: dict, dropped, open_spans) -> dict:
+    snap["counters"]["monitor/dropped_events"] = float(dropped or 0)
+    snap["gauges"]["monitor/open_spans"] = float(open_spans or 0)
+    return snap
 
 
 def render_prometheus(snap: dict) -> str:
@@ -297,8 +317,8 @@ def main(args) -> int:
     from apex_tpu.monitor.report import load_jsonl
 
     def _snap():
-        _, events = load_jsonl(args.path)
-        return snapshot(events=events)
+        header, events = load_jsonl(args.path)
+        return snapshot(events=events, header=header)
 
     if args.once:
         snap = _snap()

@@ -1,0 +1,257 @@
+"""Crash-safe flight recorder: a black box that survives the kill.
+
+Every telemetry surface so far (spans, step records, compile events,
+HBM samples, health events) lives in the Recorder's in-process ring —
+which dies with the process, exactly when a preempted, OOM-killed, or
+watchdog-aborted run most needs it. This module arms dump triggers so
+the *tail* of that ring lands on disk whenever the process is about to
+stop being able to tell its own story:
+
+- **signals** — SIGTERM/SIGINT (the preemption notice and the ^C),
+  installed idempotently in the ``trace.install_compile_logging`` mold,
+  chaining any previously-installed handler so the host's own shutdown
+  logic still runs;
+- **atexit** — normal-looking interpreter exits that never called
+  ``monitor.detach`` (an uncaught exception unwinding ``main``);
+- **fatal watchdog events** — ``health.Watchdog`` calls
+  :func:`trigger` for the conditions in ``health.FLIGHT_DUMP_EVENTS``
+  (``nan``, ``hbm_high_water``, ``memory_leak``): the dump captures the
+  last seconds *before* the crash the event forecasts;
+- **explicit** — :func:`snapshot` anywhere (serve-engine aborts,
+  elastic reshard boundaries, a debugger prompt).
+
+The dump is one rank-tagged ``flight-{process_index}.jsonl``: a
+``header`` line carrying the trigger reason + blind-spot counters
+(``dropped``, ``open_spans``), the newest ``tail_events`` ring events,
+cumulative histogram snapshots, and one ``open_span`` record per
+still-open span — the "what was rank 3 doing when it died" stack. The
+write is atomic (tmp + fsync + rename), so a kill arriving *mid-dump*
+leaves either the previous complete dump or none — never a torn file
+(``merge``/``timeline`` additionally tolerate a truncated trailing
+line, belt and braces). Repeated triggers overwrite: last dump wins.
+
+APX001 discipline: pure stdlib, no jax at import. Every trigger's
+first action is one global read — with monitoring detached, dumps are
+no-ops and the installed handlers only chain.
+
+Consume dumps with the same CLIs as live shards::
+
+    python -m apex_tpu.monitor report   flight-0.jsonl
+    python -m apex_tpu.monitor merge    'flight-*.jsonl' --json
+    python -m apex_tpu.monitor timeline flight-*.jsonl -o trace.json
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+from apex_tpu.monitor import _state
+from apex_tpu.monitor.recorder import json_line
+
+__all__ = ["install", "uninstall", "installed", "snapshot", "trigger",
+           "flight_path", "DEFAULT_TAIL_EVENTS"]
+
+DEFAULT_TAIL_EVENTS = 4096
+
+_lock = threading.Lock()
+_installed = False
+_prev_handlers: dict = {}          # signum -> previous handler
+_config = {"directory": ".", "tail_events": DEFAULT_TAIL_EVENTS,
+           "atexit_dump": False}
+
+
+def flight_path(directory: str, process_index: int) -> str:
+    """The rank-tagged flight-dump file for one process."""
+    return os.path.join(directory, f"flight-{int(process_index)}.jsonl")
+
+
+def _process_index(rec) -> int:
+    """Best-effort rank: recorder meta (set by ``merge.dump_shard`` and
+    bench), else an already-imported jax runtime, else 0. Never the
+    importer of jax (APX001)."""
+    idx = (rec.meta or {}).get("process_index")
+    if idx is not None:
+        try:
+            return int(idx)
+        except (TypeError, ValueError):
+            pass
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return int(jax.process_index())
+        except Exception:
+            pass
+    return 0
+
+
+def _open_span_records(rec) -> list[dict]:
+    """One ``open_span`` record per still-open span — the stack at dump
+    time. ``t`` is recorder-relative start time (same clock as every
+    other event), ``age_s`` how long it has been open."""
+    from apex_tpu.monitor import spans
+    now = time.perf_counter()
+    with spans._lock:
+        items = [(sid, name, parent, t0)
+                 for sid, (name, parent, t0) in spans._open.items()]
+    out = []
+    for sid, name, parent, t0 in sorted(items):
+        out.append({"kind": "open_span", "name": name, "value": sid,
+                    "parent": parent, "t": round(t0 - rec._t0, 6),
+                    "age_s": round(now - t0, 6)})
+    return out
+
+
+def snapshot(reason: str = "explicit", directory: Optional[str] = None,
+             recorder=None, tail_events: Optional[int] = None
+             ) -> Optional[str]:
+    """Dump the ring tail to ``flight-{rank}.jsonl`` now; returns the
+    path, or ``None`` when monitoring is detached (free no-op). Safe
+    from signal handlers: the Recorder lock is reentrant and the write
+    is atomic (tmp + fsync + rename)."""
+    rec = recorder if recorder is not None else _state.recorder
+    if rec is None:
+        return None
+    directory = directory if directory is not None else _config["directory"]
+    tail = tail_events if tail_events is not None else _config["tail_events"]
+    open_span_evs = _open_span_records(rec)
+    events = rec.records()
+    if tail and len(events) > tail:
+        events = events[-tail:]
+    header = {"kind": "header", "name": rec.name, "flight": True,
+              "reason": str(reason),
+              "t": round(time.perf_counter() - rec._t0, 6),
+              "wall_time_unix": round(time.time(), 3),
+              "capacity": rec.capacity, "tail_events": int(tail or 0),
+              "dropped": rec.dropped, "open_spans": len(open_span_evs),
+              "meta": dict(rec.meta)}
+    header["meta"].setdefault("process_index", _process_index(rec))
+    path = flight_path(directory, header["meta"]["process_index"])
+    with _lock:
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            os.makedirs(directory or ".", exist_ok=True)
+            f = open(tmp, "w")
+            try:
+                f.write(json_line(header) + "\n")
+                for ev in events:
+                    f.write(json_line(ev) + "\n")
+                for ev in rec._histogram_events():
+                    f.write(json_line(ev) + "\n")
+                for ev in open_span_evs:
+                    f.write(json_line(ev) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            finally:
+                f.close()
+            os.replace(tmp, path)
+        except Exception:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    return path
+
+
+def _safe_snapshot(reason: str) -> Optional[str]:
+    """Handler-path snapshot: a flight-recorder bug must never mask the
+    signal that triggered it."""
+    try:
+        return snapshot(reason)
+    except Exception:
+        return None
+
+
+def trigger(reason: str) -> Optional[str]:
+    """Dump *if armed*: a no-op unless :func:`install` has run (and
+    monitoring is attached). This is the hook the serve engine, elastic
+    resharding, and the watchdog call unconditionally — inert wiring
+    until someone opts the process into flight recording."""
+    if not _installed:
+        return None
+    return _safe_snapshot(reason)
+
+
+def _chain(signum, frame):
+    """Invoke whatever handler was installed before ours, preserving
+    the host's shutdown semantics (including default kill-by-signal)."""
+    prev = _prev_handlers.get(signum)
+    if callable(prev):
+        prev(signum, frame)
+    elif prev == signal.SIG_DFL:
+        # re-deliver under the default disposition so the exit status
+        # still says killed-by-signal (what process managers key on)
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+    # SIG_IGN / None: swallow, matching the prior disposition
+
+
+def _on_signal(signum, frame):
+    _safe_snapshot(f"signal:{signal.Signals(signum).name}")
+    _chain(signum, frame)
+
+
+def _on_atexit():
+    if _installed and _config.get("atexit_dump"):
+        _safe_snapshot("atexit")
+
+
+def install(directory: Optional[str] = None,
+            tail_events: Optional[int] = None,
+            signals=(signal.SIGTERM, signal.SIGINT),
+            atexit_dump: bool = True) -> bool:
+    """Arm the flight recorder (idempotent; returns True on the first,
+    arming call). Signal handlers are installed only from the main
+    thread (``signal.signal`` raises elsewhere) and chain any prior
+    handler; repeat calls just update ``directory``/``tail_events``.
+    Nothing here touches jax or the recorder — arming a detached
+    process is legal and free until something attaches."""
+    global _installed
+    if directory is not None:
+        _config["directory"] = directory
+    if tail_events is not None:
+        _config["tail_events"] = int(tail_events)
+    _config["atexit_dump"] = bool(atexit_dump)
+    if _installed:
+        return False
+    if threading.current_thread() is threading.main_thread():
+        for signum in signals:
+            try:
+                _prev_handlers[signum] = signal.getsignal(signum)
+                signal.signal(signum, _on_signal)
+            except (ValueError, OSError):
+                pass
+    atexit.register(_on_atexit)
+    _installed = True
+    return True
+
+
+def installed() -> bool:
+    return _installed
+
+
+def uninstall():
+    """Disarm and restore the chained handlers (test hygiene)."""
+    global _installed
+    if not _installed:
+        return
+    if threading.current_thread() is threading.main_thread():
+        for signum, prev in list(_prev_handlers.items()):
+            try:
+                if signal.getsignal(signum) is _on_signal:
+                    signal.signal(signum, prev if prev is not None
+                                  else signal.SIG_DFL)
+            except (ValueError, OSError):
+                pass
+    _prev_handlers.clear()
+    try:
+        atexit.unregister(_on_atexit)
+    except Exception:
+        pass
+    _installed = False

@@ -94,6 +94,11 @@ HEALTH_EVENT_KINDS = (
     "hbm_high_water", "memory_leak", "recompile_storm",
 )
 
+# Conditions fatal enough that the process may not get another chance
+# to tell its story: each firing also triggers a flight-recorder dump
+# (apex_tpu.monitor.flight — inert unless flight.install() armed it).
+FLIGHT_DUMP_EVENTS = ("nan", "hbm_high_water", "memory_leak")
+
 
 def _finite(v) -> bool:
     try:
@@ -230,6 +235,14 @@ class Watchdog:
         if self.on_event is not None:
             try:
                 self.on_event(ev)
+            except Exception:
+                pass
+        if name in FLIGHT_DUMP_EVENTS:
+            # fatal forecast: dump the black box while the process can
+            # still write (no-op unless flight.install() armed dumps)
+            try:
+                from apex_tpu.monitor import flight as _flight
+                _flight.trigger(f"health:{name}")
             except Exception:
                 pass
         return ev

@@ -37,6 +37,7 @@ from apex_tpu.monitor import _state
 from apex_tpu.monitor.report import aggregate, load_jsonl
 
 SHARD_RE = re.compile(r"monitor-(\d+)\.jsonl$")
+FLIGHT_RE = re.compile(r"flight-(\d+)\.jsonl$")
 
 
 def shard_path(directory: str, process_index: int) -> str:
@@ -66,11 +67,20 @@ def dump_shard(recorder, directory: str, process_index: Optional[int] = None,
 
 
 def find_shards(directory: str) -> list[str]:
-    """All ``monitor-<rank>.jsonl`` files in ``directory``, rank order."""
-    paths = glob.glob(os.path.join(directory, "monitor-*.jsonl"))
-    tagged = [(int(SHARD_RE.search(p).group(1)), p)
-              for p in paths if SHARD_RE.search(p)]
-    return [p for _, p in sorted(tagged)]
+    """All ``monitor-<rank>.jsonl`` files in ``directory``, rank order.
+    Flight dumps (``flight-<rank>.jsonl``) fill in ranks that left no
+    live shard — a killed run's black box merges like any other shard,
+    but a rank with both contributes only the live shard (the flight
+    dump is a bounded tail of the same recorder: counting both would
+    double its collectives)."""
+    tagged = {}
+    for pattern, rx in (("flight-*.jsonl", FLIGHT_RE),
+                        ("monitor-*.jsonl", SHARD_RE)):
+        for p in glob.glob(os.path.join(directory, pattern)):
+            m = rx.search(p)
+            if m:
+                tagged[int(m.group(1))] = p   # monitor- wins, second pass
+    return [p for _, p in sorted(tagged.items())]
 
 
 def rank_summary(header: dict, events: Iterable[dict],

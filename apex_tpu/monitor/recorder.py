@@ -49,6 +49,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import json
+import os
 import sys
 import threading
 import time
@@ -414,25 +415,38 @@ class Recorder:
     def dump_jsonl(self, path_or_file) -> int:
         """Write one JSON object per event (newest ``capacity`` events);
         first line is a header record. Returns the number of event lines
-        written."""
+        written. Path writes are atomic (tmp + fsync + rename): a kill
+        arriving mid-dump leaves the previous complete file or none,
+        never a torn shard the merge CLI chokes on."""
         _effects_barrier()
+        from apex_tpu.monitor.spans import open_spans
         header = {"kind": "header", "name": self.name,
                   "capacity": self.capacity, "dropped": self.dropped,
-                  "meta": self.meta}
+                  "open_spans": open_spans(), "meta": self.meta}
         evs = self.records() + self._histogram_events()
-        if hasattr(path_or_file, "write"):
-            f = path_or_file
-            close = False
-        else:
-            f = open(path_or_file, "w")
-            close = True
-        try:
+
+        def _write(f):
             f.write(json_line(header) + "\n")
             for e in evs:
                 f.write(json_line(e) + "\n")
-        finally:
-            if close:
-                f.close()
+
+        if hasattr(path_or_file, "write"):
+            _write(path_or_file)
+            return len(evs)
+        path = os.fspath(path_or_file)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            with open(tmp, "w") as f:
+                _write(f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except Exception:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         return len(evs)
 
     def aggregate(self) -> dict:

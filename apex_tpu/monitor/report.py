@@ -9,11 +9,18 @@ stdlib — reports render anywhere, including hosts with no jax.
 from __future__ import annotations
 
 import json
+import warnings
 from typing import Iterable, Optional
 
 
 def load_jsonl(path_or_file) -> tuple[dict, list[dict]]:
-    """Read a ``Recorder.dump_jsonl`` file → (header, events)."""
+    """Read a ``Recorder.dump_jsonl`` file → (header, events).
+
+    A truncated *trailing* line (a process killed mid-append to a
+    streamed file) is dropped with a warning instead of raising — a
+    crash must never produce a dump the merge/report CLIs choke on.
+    Corruption anywhere else still raises: that is a damaged file, not
+    an interrupted append."""
     if hasattr(path_or_file, "read"):
         lines = path_or_file.read().splitlines()
     else:
@@ -21,11 +28,18 @@ def load_jsonl(path_or_file) -> tuple[dict, list[dict]]:
             lines = f.read().splitlines()
     header: dict = {}
     events: list[dict] = []
-    for ln in lines:
-        ln = ln.strip()
-        if not ln:
-            continue
-        obj = json.loads(ln)
+    nonempty = [ln.strip() for ln in lines if ln.strip()]
+    for i, ln in enumerate(nonempty):
+        try:
+            obj = json.loads(ln)
+        except json.JSONDecodeError:
+            if i == len(nonempty) - 1:
+                warnings.warn(
+                    f"dropping truncated trailing line ({len(ln)} bytes) "
+                    f"from {getattr(path_or_file, 'name', path_or_file)}",
+                    RuntimeWarning, stacklevel=2)
+                break
+            raise
         if obj.get("kind") == "header" and not header:
             header = obj
         else:

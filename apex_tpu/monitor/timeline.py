@@ -1,0 +1,446 @@
+"""Chrome-trace / Perfetto exporter: one timeline across every rank.
+
+``python -m apex_tpu.monitor timeline <shards-or-flight-dumps...> -o
+trace.json`` fuses rank-tagged recorder dumps (live ``monitor-N.jsonl``
+shards and crash ``flight-N.jsonl`` dumps alike) into one Catapult
+JSON that chrome://tracing and https://ui.perfetto.dev open directly:
+
+- **one process track per rank** (``pid`` = process_index, named via
+  ``process_name`` metadata), with fixed threads for steps, compile,
+  health, and counters, plus one thread per span *tree* so concurrent
+  requests render as parallel rows;
+- **span trees** as duration events — closed spans are complete
+  (``ph:"X"``) events nested by containment, spans still open at dump
+  time (``span_start`` without ``span_end``, and the flight recorder's
+  ``open_span`` stack) are unterminated ``ph:"B"`` events, which
+  Perfetto renders as running-to-end-of-trace: the kill-time stack is
+  visible at a glance;
+- **compile events**: the ``jax/compile/trace|lower|backend`` timers
+  (emitted at completion, so ``ts = t - duration``) as duration events,
+  cache hits/misses as instants on the compile thread;
+- **``memory/hbm_*`` sampler series** as counter tracks (``ph:"C"``);
+- **health/watchdog events** as process-scoped instants (``ph:"i"``)
+  — the nan/OOM-forecast/straggler marks sit on the same time axis as
+  the spans that caused them.
+
+Cross-rank clock alignment: every recorder stamps events with its own
+``perf_counter`` origin, so rank clocks are mutually offset. Step
+records carry their step index and start time; SPMD ranks execute the
+same step numbers, so the per-rank offset to the reference rank is the
+median of ``t_ref[step] - t_rank[step]`` over shared step indices —
+robust to stragglers, exact enough to line up step boundaries. Ranks
+sharing no step indices stay unaligned (offset 0, noted in metadata).
+
+Straggler overlay (reusing :mod:`apex_tpu.monitor.merge`'s skew
+machinery): per shared step, each rank's step time over the cross-rank
+median rides a ``step/over_median`` counter track, and any step whose
+slowest rank exceeds ``straggler_ratio`` x the median gets a named
+instant on that rank; the run-level ``steps.skew`` block from
+``merge_summaries`` (per-rank ratio, slowest rank) lands in the trace
+metadata.
+
+Pure stdlib, no jax import (APX001): timelines render anywhere,
+including hosts with no accelerator — the triage path for a run that
+no longer exists.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import re
+from typing import Iterable, Optional
+
+from apex_tpu.monitor.report import load_jsonl
+
+__all__ = ["load_sources", "build_timeline", "validate_timeline",
+           "write_timeline"]
+
+RANK_RE = re.compile(r"(?:monitor|flight)-(\d+)\.jsonl$")
+
+# fixed per-rank thread ids (span trees get TID_SPAN_BASE + k)
+TID_STEPS = 1
+TID_COMPILE = 2
+TID_HEALTH = 3
+TID_COUNTERS = 4
+TID_SPAN_BASE = 10
+
+COMPILE_TIMERS = ("jax/compile/trace", "jax/compile/lower",
+                  "jax/compile/backend")
+HBM_PREFIX = "memory/hbm_"
+STRAGGLER_RATIO = 1.5
+
+_GLOB_CHARS = ("*", "?", "[")
+
+
+def _expand(specs: Iterable[str]) -> list[str]:
+    """Paths from a mix of files, directories (all shards + flight
+    dumps inside), and glob patterns; order-preserving, deduplicated."""
+    paths: list[str] = []
+    for spec in specs:
+        spec = os.fspath(spec)
+        if os.path.isdir(spec):
+            paths.extend(sorted(
+                _glob.glob(os.path.join(spec, "monitor-*.jsonl"))
+                + _glob.glob(os.path.join(spec, "flight-*.jsonl"))))
+        elif any(c in spec for c in _GLOB_CHARS):
+            paths.extend(sorted(_glob.glob(spec)))
+        else:
+            paths.append(spec)
+    seen: set = set()
+    return [p for p in paths if not (p in seen or seen.add(p))]
+
+
+def load_sources(specs: Iterable[str]) -> list[dict]:
+    """Load dump files into per-rank groups ``{rank, paths, headers,
+    events}``. Rank comes from the header ``meta.process_index``, else
+    the ``monitor-N``/``flight-N`` filename, else enumeration order; a
+    shard and a flight dump of the same rank fuse into one group."""
+    loaded = []
+    for path in _expand(specs):
+        header, events = load_jsonl(path)
+        rank = (header.get("meta") or {}).get("process_index")
+        if rank is None:
+            m = RANK_RE.search(os.path.basename(path))
+            rank = int(m.group(1)) if m else None
+        loaded.append({"path": path, "rank": rank,
+                       "header": header, "events": events})
+    used = {s["rank"] for s in loaded if s["rank"] is not None}
+    nxt = 0
+    for s in loaded:
+        if s["rank"] is None:
+            while nxt in used:
+                nxt += 1
+            s["rank"] = nxt
+            used.add(nxt)
+    groups: dict[int, dict] = {}
+    for s in loaded:
+        g = groups.setdefault(s["rank"], {"rank": int(s["rank"]),
+                                          "paths": [], "headers": [],
+                                          "events": []})
+        g["paths"].append(s["path"])
+        g["headers"].append(s["header"])
+        g["events"].extend(s["events"])
+    return [groups[r] for r in sorted(groups)]
+
+
+def _num(v) -> Optional[float]:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return f if f == f and f not in (float("inf"), float("-inf")) else None
+
+
+def _step_starts(events: list[dict]) -> dict[int, float]:
+    out = {}
+    for ev in events:
+        if ev.get("kind") == "step":
+            t = _num(ev.get("t"))
+            if t is not None and ev.get("step") is not None:
+                out[int(ev["step"])] = t
+    return out
+
+
+def _median(xs: list[float]) -> float:
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+def clock_offsets(sources: list[dict]) -> dict[int, float]:
+    """Per-rank seconds to ADD to local event times to land on the
+    reference (lowest) rank's clock, from shared step-boundary events
+    (module docstring)."""
+    if not sources:
+        return {}
+    ref = _step_starts(sources[0]["events"])
+    offsets = {sources[0]["rank"]: 0.0}
+    for src in sources[1:]:
+        mine = _step_starts(src["events"])
+        common = sorted(set(ref) & set(mine))
+        offsets[src["rank"]] = (
+            _median([ref[k] - mine[k] for k in common]) if common else 0.0)
+    return offsets
+
+
+def _us(t_s: float) -> float:
+    return round(t_s * 1e6, 3)
+
+
+def _span_args(ev: dict) -> dict:
+    skip = {"kind", "name", "value", "t", "span", "parent", "step"}
+    return {k: v for k, v in ev.items() if k not in skip}
+
+
+def _rank_span_events(events: list[dict], pid: int, off: float,
+                      tid_of_root, out: list[dict]):
+    """Span trees → X (closed) / unterminated B (open) duration events,
+    one thread per root span so concurrent requests stack cleanly."""
+    starts: dict = {}
+    parent_of: dict = {}
+    names: dict = {}
+    closed = []           # (sid, t0, dur, name, args)
+    opens: dict = {}      # sid -> (t0, name, args)
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "span_start":
+            sid = ev.get("value")
+            if sid is not None:
+                starts[sid] = ev
+                parent_of[sid] = ev.get("parent")
+                names[sid] = ev.get("name")
+        elif kind == "span_end":
+            sid = ev.get("span")
+            dur = _num(ev.get("value")) or 0.0
+            t_end = _num(ev.get("t")) or 0.0
+            s = starts.pop(sid, None)
+            t0 = _num(s.get("t")) if s is not None else None
+            if t0 is None:
+                t0 = t_end - dur
+            args = _span_args(s) if s is not None else {}
+            args.update(_span_args(ev))
+            if sid is not None and sid not in parent_of:
+                parent_of[sid] = ev.get("parent")
+                names[sid] = ev.get("name")
+            closed.append((sid, t0, dur, ev.get("name"), args))
+            opens.pop(sid, None)
+        elif kind == "open_span":
+            sid = ev.get("value")
+            t0 = _num(ev.get("t")) or 0.0
+            if sid is not None:
+                parent_of[sid] = ev.get("parent")
+                names[sid] = ev.get("name")
+                opens[sid] = (t0, ev.get("name"), _span_args(ev))
+                starts.pop(sid, None)
+    # span_start with neither end nor open_span record: open at dump time
+    for sid, ev in starts.items():
+        opens.setdefault(sid, (_num(ev.get("t")) or 0.0, ev.get("name"),
+                               _span_args(ev)))
+
+    def root_of(sid):
+        cur, hops = sid, 0
+        while hops < 1000:
+            p = parent_of.get(cur)
+            if p is None or p == cur or p not in parent_of:
+                return cur
+            cur, hops = p, hops + 1
+        return cur
+
+    for sid, t0, dur, name, args in closed:
+        tid = tid_of_root(root_of(sid), names.get(root_of(sid)) or name)
+        out.append({"ph": "X", "name": name, "pid": pid, "tid": tid,
+                    "ts": _us(t0 + off), "dur": _us(max(dur, 0.0)),
+                    "args": {**args, "span": sid,
+                             "parent": parent_of.get(sid)}})
+    for sid, (t0, name, args) in sorted(opens.items()):
+        tid = tid_of_root(root_of(sid), names.get(root_of(sid)) or name)
+        out.append({"ph": "B", "name": name, "pid": pid, "tid": tid,
+                    "ts": _us(t0 + off),
+                    "args": {**args, "span": sid, "open_at_dump": True,
+                             "parent": parent_of.get(sid)}})
+
+
+def build_timeline(sources: list[dict], align: bool = True,
+                   straggler_ratio: float = STRAGGLER_RATIO) -> dict:
+    """Fuse per-rank source groups (:func:`load_sources`) into one
+    Chrome-trace dict (``{"traceEvents": [...], ...}``)."""
+    offsets = clock_offsets(sources) if align else \
+        {s["rank"]: 0.0 for s in sources}
+    events: list[dict] = []
+    step_durs: dict[int, dict[int, float]] = {}   # step -> rank -> dur
+    step_ts: dict[int, dict[int, float]] = {}     # step -> rank -> ts (aligned)
+
+    for src in sources:
+        pid = src["rank"]
+        off = offsets.get(pid, 0.0)
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": f"rank {pid}"}})
+        for tid, tname in ((TID_STEPS, "steps"), (TID_COMPILE, "compile"),
+                           (TID_HEALTH, "health")):
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": tid, "args": {"name": tname}})
+        span_tids: dict = {}
+
+        def tid_of_root(root_sid, root_name, _pid=pid,
+                        _tids=span_tids):
+            tid = _tids.get(root_sid)
+            if tid is None:
+                tid = TID_SPAN_BASE + len(_tids)
+                _tids[root_sid] = tid
+                events.append({"ph": "M", "name": "thread_name",
+                               "pid": _pid, "tid": tid,
+                               "args": {"name": f"span/{root_name}"}})
+            return tid
+
+        for ev in src["events"]:
+            kind = ev.get("kind")
+            t = _num(ev.get("t"))
+            if kind == "step" and t is not None:
+                dur = _num(ev.get("value")) or 0.0
+                idx = ev.get("step")
+                events.append({
+                    "ph": "X", "name": f"step {idx}", "pid": pid,
+                    "tid": TID_STEPS, "ts": _us(t + off),
+                    "dur": _us(max(dur, 0.0)),
+                    "args": {"step": idx, "step_time_s": dur}})
+                if idx is not None:
+                    step_durs.setdefault(int(idx), {})[pid] = dur
+                    step_ts.setdefault(int(idx), {})[pid] = _us(t + off)
+            elif kind == "timer" and ev.get("name") in COMPILE_TIMERS \
+                    and t is not None:
+                dur = _num(ev.get("value")) or 0.0
+                events.append({
+                    "ph": "X", "name": ev["name"], "pid": pid,
+                    "tid": TID_COMPILE, "ts": _us(t - dur + off),
+                    "dur": _us(max(dur, 0.0)),
+                    "args": {k: v for k, v in ev.items()
+                             if k not in ("kind", "name", "value", "t")}})
+            elif kind == "counter" and t is not None and \
+                    str(ev.get("name", "")).startswith("jax/compile/cache_"):
+                events.append({
+                    "ph": "i", "name": ev["name"], "pid": pid,
+                    "tid": TID_COMPILE, "ts": _us(t + off), "s": "t",
+                    "args": {"total": ev.get("total")}})
+            elif kind == "gauge" and t is not None and \
+                    str(ev.get("name", "")).startswith(HBM_PREFIX):
+                v = _num(ev.get("value"))
+                if v is not None:
+                    events.append({
+                        "ph": "C", "name": ev["name"], "pid": pid,
+                        "tid": TID_COUNTERS, "ts": _us(t + off),
+                        "args": {"value": v}})
+            elif kind == "health_event" and t is not None:
+                events.append({
+                    "ph": "i", "name": f"health/{ev.get('name')}",
+                    "pid": pid, "tid": TID_HEALTH, "ts": _us(t + off),
+                    "s": "p",
+                    "args": {"severity": ev.get("severity"),
+                             "diagnosis": ev.get("diagnosis"),
+                             "step": ev.get("step"),
+                             "value": ev.get("value")}})
+        _rank_span_events(src["events"], pid, off, tid_of_root, events)
+
+    # straggler overlay: per shared step, each rank's time over the
+    # cross-rank median; slowest rank named when past the bar
+    for idx in sorted(step_durs):
+        durs = step_durs[idx]
+        if len(durs) < 2:
+            continue
+        med = _median(list(durs.values()))
+        for pid, dur in durs.items():
+            ratio = dur / med if med > 0 else 0.0
+            events.append({"ph": "C", "name": "step/over_median",
+                           "pid": pid, "tid": TID_COUNTERS,
+                           "ts": step_ts[idx][pid],
+                           "args": {"value": round(ratio, 3)}})
+        slowest = max(durs, key=durs.get)
+        ratio = durs[slowest] / med if med > 0 else 0.0
+        if ratio >= straggler_ratio:
+            events.append({
+                "ph": "i", "pid": slowest, "tid": TID_STEPS,
+                "ts": step_ts[idx][slowest], "s": "p",
+                "name": f"straggler: rank {slowest} "
+                        f"{ratio:.2f}x median (step {idx})",
+                "args": {"step": idx, "ratio": round(ratio, 3),
+                         "median_step_time_s": round(med, 6)}})
+
+    # run-level skew block via the existing merge machinery
+    skew = None
+    try:
+        from apex_tpu.monitor import merge as _merge
+        summaries = [_merge.rank_summary(
+            (s["headers"] or [{}])[0], s["events"], rank=s["rank"])
+            for s in sources]
+        if summaries:
+            skew = _merge.merge_summaries(summaries).get(
+                "steps", {}).get("skew")
+    except Exception:
+        skew = None
+
+    # stable, per-track-monotonic order: metadata first, then by track/ts
+    def sort_key(ev):
+        # at equal ts, the longer duration (the enclosing parent) first
+        return (0 if ev["ph"] == "M" else 1, ev["pid"],
+                ev.get("tid", 0) or 0, ev.get("ts", 0.0) or 0.0,
+                -(ev.get("dur", 0.0) or 0.0))
+
+    events.sort(key=sort_key)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "apex_tpu_timeline": {
+                "n_ranks": len(sources),
+                "sources": {str(s["rank"]): s["paths"] for s in sources},
+                "clock_offset_s": {str(r): round(o, 6)
+                                   for r, o in offsets.items()},
+                "aligned": bool(align),
+                "straggler_ratio": straggler_ratio,
+                "skew": skew,
+            }
+        },
+    }
+
+
+def validate_timeline(trace: dict) -> list[str]:
+    """Shape-check a Chrome-trace dict; returns a list of problems
+    (empty = valid). Checks the contract the CI gate enforces: every
+    event has ``ph``/``pid`` (+ ``ts`` off the metadata phase),
+    timestamps are monotonic per (pid, tid) track in list order,
+    duration events carry non-negative ``dur``, and B/E begin/end
+    events balance per track (unterminated B's — the open-span stack —
+    are allowed; an E without a B is not)."""
+    errs: list[str] = []
+    evs = trace.get("traceEvents")
+    if not isinstance(evs, list) or not evs:
+        return ["traceEvents missing or empty"]
+    last_ts: dict = {}
+    stacks: dict = {}
+    for i, ev in enumerate(evs):
+        if not isinstance(ev, dict):
+            errs.append(f"event {i}: not an object")
+            continue
+        ph = ev.get("ph")
+        if not ph:
+            errs.append(f"event {i}: missing ph")
+        if ev.get("pid") is None:
+            errs.append(f"event {i}: missing pid")
+        if ph == "M":
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            errs.append(f"event {i}: missing/non-numeric ts")
+            continue
+        key = (ev.get("pid"), ev.get("tid"))
+        prev = last_ts.get(key)
+        if prev is not None and ts < prev - 1e-6:
+            errs.append(f"event {i}: ts {ts} < {prev} on track {key}")
+        last_ts[key] = max(ts, prev) if prev is not None else ts
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errs.append(f"event {i}: X event needs dur >= 0")
+        elif ph == "B":
+            stacks.setdefault(key, []).append(ev.get("name"))
+        elif ph == "E":
+            st = stacks.get(key)
+            if not st:
+                errs.append(f"event {i}: E without matching B on "
+                            f"track {key}")
+            else:
+                st.pop()
+    return errs
+
+
+def write_timeline(trace: dict, path: str) -> str:
+    """Serialize a trace dict to ``path`` atomically."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(trace, f)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path

@@ -51,6 +51,7 @@ import numpy as np
 from apex_tpu._compat import shard_map
 from apex_tpu.models.gpt import GPTConfig
 from apex_tpu.monitor import _state as _monitor_state
+from apex_tpu.monitor import flight as _mflight
 from apex_tpu.monitor import hooks as _mhooks
 from apex_tpu.monitor import spans as _mspans
 from apex_tpu.serve import cache as cache_mod
@@ -376,12 +377,19 @@ class ServeEngine:
         steps = 0
         t0 = time.perf_counter()
         tok0 = self.tokens_generated
-        while self.sched.has_work:
-            self.step()
-            steps += 1
-            if steps > max_steps:
-                raise RuntimeError("serve engine did not drain "
-                                   f"in {max_steps} steps")
+        try:
+            while self.sched.has_work:
+                self.step()
+                steps += 1
+                if steps > max_steps:
+                    raise RuntimeError("serve engine did not drain "
+                                       f"in {max_steps} steps")
+        except BaseException:
+            # abort path: leave the black box (in-flight request spans
+            # are still open — the flight dump names them). Inert
+            # unless flight.install() armed dumps.
+            _mflight.trigger("serve/abort")
+            raise
         self._record_run_summary(t0, tok0)
         return {sid: s.tokens[len(s.prompt):]
                 for sid, s in self.seqs.items()}
@@ -416,16 +424,21 @@ class ServeEngine:
         binds an ephemeral port (``self.export_port`` holds the bound
         port). Without ``export_port`` this IS ``run()`` — no thread,
         no ``http.server`` import."""
-        if export_port is None:
-            return self.run(max_steps=max_steps)
-        from apex_tpu.monitor import export as export_mod
-        exporter = export_mod.MetricsExporter(port=export_port,
-                                              addr=export_addr)
-        self.export_port = exporter.start()
         try:
-            return self.run(max_steps=max_steps)
+            if export_port is None:
+                return self.run(max_steps=max_steps)
+            from apex_tpu.monitor import export as export_mod
+            exporter = export_mod.MetricsExporter(port=export_port,
+                                                  addr=export_addr)
+            self.export_port = exporter.start()
+            try:
+                return self.run(max_steps=max_steps)
+            finally:
+                exporter.stop()
         finally:
-            exporter.stop()
+            # engine shutdown: snapshot the final SLO/occupancy state
+            # (no-op unless the flight recorder is armed)
+            _mflight.trigger("serve/shutdown")
 
 
 def naive_generate(cfg: GPTConfig, params, requests, *, max_seq_len: int,

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from typing import Any
 
+from apex_tpu.monitor import flight as _mflight
 from apex_tpu.zero.core import (ZeroSpec, gather_tree as _gather_tree,
                                 shard_tree as _shard_tree)
 from apex_tpu.zero.update import Zero3State
@@ -29,16 +30,23 @@ __all__ = [
     "gather_zero3_state", "shard_zero3_state",
 ]
 
+# Reshard boundaries are where elastic runs die (a preemption arriving
+# mid-topology-change is the worst-timed kill there is), so each one
+# snapshots the flight recorder — a no-op unless flight.install()
+# armed dumps, and free when monitoring is detached.
+
 
 def gather_zero3_params(shards: Any, spec: ZeroSpec) -> Any:
     """Full (topology-independent) parameter tree from the resident
     shards — the checkpoint form. Identical on every rank."""
+    _mflight.trigger("zero/reshard:gather_params")
     return _gather_tree(shards, spec)
 
 
 def shard_zero3_params(params: Any, spec: ZeroSpec) -> Any:
     """Resident shards of a full tree under the CURRENT mesh — the
     resume path (build a fresh spec on the new mesh first)."""
+    _mflight.trigger("zero/reshard:shard_params")
     return _shard_tree(params, spec)
 
 
@@ -46,6 +54,7 @@ def gather_zero3_state(state: Zero3State, spec: ZeroSpec) -> Zero3State:
     """Topology-independent tier-3 optimizer state: master/m/v gathered
     to full parameter-shaped fp32 trees (step passes through). What
     ``save_checkpoint`` should write next to the gathered params."""
+    _mflight.trigger("zero/reshard:gather_state")
     return Zero3State(
         step=state.step,
         master=_gather_tree(state.master, spec),
@@ -59,6 +68,7 @@ def shard_zero3_state(full_state: Zero3State, spec: ZeroSpec) -> Zero3State:
     dp=8 state resumes on dp=4 (and back) bit-exactly, padded tails
     included (padding is zeros in every buffer, and zero slots never
     influence the update)."""
+    _mflight.trigger("zero/reshard:shard_state")
     return Zero3State(
         step=full_state.step,
         master=_shard_tree(full_state.master, spec),

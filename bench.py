@@ -2928,6 +2928,11 @@ def main(argv=None) -> int:
                            traced_hooks=False, stream=stream_path)
     monitor.trace.install_compile_logging()
     monitor.attach(rec)
+    # arm the flight recorder next to the stream: a killed run leaves
+    # BOTH its partial evidence stream and a flight-<rank>.jsonl black
+    # box (ring tail + open-span stack) for `monitor timeline` triage
+    monitor.flight.install(
+        directory=os.path.dirname(os.path.abspath(stream_path)) or ".")
 
     ctx: dict = {}
     done = {"final": None}
@@ -2955,6 +2960,10 @@ def main(argv=None) -> int:
         return out
 
     def _on_term(signum, frame):
+        # flight dump FIRST: finalize() detaches the recorder, after
+        # which a snapshot would be a no-op (bench replaced flight's
+        # own SIGTERM handler, so this is the one dump this run gets)
+        monitor.flight.trigger("SIGTERM")
         finalize(interrupted="SIGTERM")
         os._exit(143)
 

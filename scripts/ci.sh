@@ -50,7 +50,14 @@
 #                    bench memory section samples; plus `monitor
 #                    profile --model gpt` must report an MFU line from
 #                    the per-device_kind peak table
-#   4c. memory     — python -m apex_tpu.monitor memory --model gpt
+#   4c. timeline   — python -m apex_tpu.monitor timeline: the smoke
+#                    stream must fuse into a Chrome-trace/Perfetto JSON
+#                    that passes an INDEPENDENT shape check (every event
+#                    carries ph/pid + numeric ts off the metadata phase,
+#                    per-(pid,tid) track timestamps monotonic, B/E
+#                    begin/end balanced with unterminated B's allowed)
+#                    and still contains span + compile + counter tracks
+#   4d. memory     — python -m apex_tpu.monitor memory --model gpt
 #                    --json: the unified byte surface must attribute
 #                    the canonical step's analytic peak to a NAMED
 #                    apx: scope, report a compiled footprint, and run
@@ -229,6 +236,52 @@ grep -q "^MFU: " /tmp/ci_profile_mfu.txt || {
 # export of the smoke stream has to carry memory/ metrics
 grep -q "^apex_memory_" /tmp/ci_export.txt || {
   echo "ci: export scrape carries no memory/ gauges"; fail=1; }
+
+echo "== ci: monitor timeline (Perfetto trace shape check) =="
+# the smoke stream must fuse into a valid Chrome-trace JSON; the shape
+# check below is deliberately independent of validate_timeline (the
+# bench-stream-keys pattern: the gate re-derives the contract itself)
+python -m apex_tpu.monitor timeline /tmp/ci_bench_smoke_stream.jsonl \
+    -o /tmp/ci_trace.json || fail=1
+python - /tmp/ci_trace.json <<'EOF' || fail=1
+import json, sys
+trace = json.load(open(sys.argv[1]))
+evs = trace.get("traceEvents") or []
+assert evs, "trace has no events"
+last = {}
+stacks = {}
+for i, ev in enumerate(evs):
+    assert ev.get("ph"), f"event {i} missing ph: {ev}"
+    assert ev.get("pid") is not None, f"event {i} missing pid: {ev}"
+    if ev["ph"] == "M":
+        continue
+    ts = ev.get("ts")
+    assert isinstance(ts, (int, float)), f"event {i} bad ts: {ev}"
+    key = (ev["pid"], ev.get("tid"))
+    prev = last.get(key)
+    assert prev is None or ts >= prev - 1e-6, \
+        f"event {i}: ts {ts} < {prev} on track {key}"
+    last[key] = max(ts, prev) if prev is not None else ts
+    if ev["ph"] == "B":
+        stacks.setdefault(key, []).append(ev.get("name"))
+    elif ev["ph"] == "E":
+        assert stacks.get(key), f"event {i}: E without B on {key}"
+        stacks[key].pop()
+# the smoke run's telemetry must actually land as tracks: spans from
+# the serve section, compile timers, and the hbm counter series
+phs = {e["ph"] for e in evs}
+names = {e.get("name") for e in evs}
+assert "X" in phs and "M" in phs, sorted(phs)
+assert any(str(n).startswith("jax/compile/") for n in names), \
+    "no compile events in trace"
+assert any(e["ph"] == "C" for e in evs), "no counter tracks in trace"
+threads = {(e.get("args") or {}).get("name") for e in evs
+           if e["ph"] == "M" and e.get("name") == "thread_name"}
+assert any(str(t).startswith("span/") for t in threads
+           if t is not None), f"no span threads in trace: {threads}"
+print(f"ci: timeline ok — {len(evs)} events, shape-checked "
+      f"(ph/pid/ts, per-track monotonic, B/E balanced)")
+EOF
 
 echo "== ci: monitor memory (unified byte surface self-check) =="
 # the memory CLI must answer "which module owns the peak" with a NAMED

@@ -121,6 +121,16 @@ def test_bench_sigterm_preserves_completed_sections(tmp_path):
         events = [json.loads(ln) for ln in f.read().splitlines()]
     names = [e["name"] for e in events if e["kind"] == "section"]
     assert names == completed
+    # the flight recorder dumped next to the stream on the way down:
+    # the black box holds the completed-section events too
+    flight_dump = os.path.join(str(tmp_path), "flight-0.jsonl")
+    assert os.path.exists(flight_dump), os.listdir(str(tmp_path))
+    from apex_tpu import monitor
+    fheader, fevents = monitor.load_jsonl(flight_dump)
+    assert fheader.get("flight") is True
+    assert fheader["reason"] == "SIGTERM"          # bench's own trigger
+    fsections = {e["name"] for e in fevents if e.get("kind") == "section"}
+    assert set(completed) <= fsections
     # --assemble rebuilds the evidence from the partial stream
     proc2 = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"),

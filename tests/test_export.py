@@ -44,8 +44,12 @@ def _mini_recorder():
 
 
 GOLDEN = """\
+# TYPE apex_monitor_dropped_events_total counter
+apex_monitor_dropped_events_total 0
 # TYPE apex_serve_preemptions_total counter
 apex_serve_preemptions_total 3
+# TYPE apex_monitor_open_spans gauge
+apex_monitor_open_spans 0
 # TYPE apex_serve_pages_free gauge
 apex_serve_pages_free 5
 # TYPE apex_serve_queue_depth gauge
@@ -87,15 +91,17 @@ def test_scrape_parse_roundtrip_matches_aggregate():
 
 
 def test_snapshot_from_events_matches_live():
-    """The file-backed CLI path: dump -> load -> snapshot(events=...)
-    must carry the same values as the live recorder snapshot."""
+    """The file-backed CLI path: dump -> load -> snapshot(events=...,
+    header=...) must carry the same values as the live recorder
+    snapshot — including the monitor blind-spot metrics, which the
+    file path reads from the dump header."""
     rec = _mini_recorder()
     buf = io.StringIO()
     rec.dump_jsonl(buf)
     buf.seek(0)
-    _, events = monitor.load_jsonl(buf)
+    header, events = monitor.load_jsonl(buf)
     live = export.snapshot(recorder=rec)
-    from_file = export.snapshot(events=events)
+    from_file = export.snapshot(events=events, header=header)
     assert from_file["counters"] == live["counters"]
     assert from_file["gauges"] == live["gauges"]
     assert from_file["histograms"]["serve/ttft_ms"]["counts"] == \

@@ -1,0 +1,391 @@
+"""monitor.flight: the crash-safe flight recorder.
+
+Contracts:
+
+- detached = free: snapshot/trigger are no-ops with no recorder
+  attached, and trigger is additionally inert until install() arms it
+  (the serve/zero/health wiring costs one global read);
+- the dump: rank-tagged ``flight-<rank>.jsonl`` holding a flight
+  header (reason, dropped, open_spans), the newest ``tail_events``
+  ring events, histogram snapshots, and the open-span stack — and it
+  round-trips through report/merge/timeline like any shard;
+- atomicity: ``Recorder.dump_jsonl`` goes tmp + fsync + rename (no
+  torn shards), and ``load_jsonl`` tolerates a truncated *trailing*
+  line with a warning while still raising on mid-file corruption;
+- signal path: idempotent install in the ``install_compile_logging``
+  mold, chaining any prior handler; a SIGTERM'd subprocess mid-step
+  leaves a parseable dump with the kill-time open-span stack
+  (ISSUE 17 acceptance) and still dies by signal;
+- fatal watchdog events (``health.FLIGHT_DUMP_EVENTS``) trigger dumps;
+- the ring blind spots export to Prometheus
+  (``apex_monitor_dropped_events_total``, ``apex_monitor_open_spans``);
+- the merge CLI accepts globs and exits 2 with a clear message when
+  nothing matches.
+"""
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from apex_tpu import monitor
+from apex_tpu.monitor import flight, health, spans
+from apex_tpu.monitor.__main__ import main as cli_main
+from apex_tpu.monitor.report import load_jsonl
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _flight_hygiene():
+    """Each test starts disarmed/detached and leaks neither handlers,
+    an attached recorder, nor open spans (several tests deliberately
+    leave spans open to exercise the kill-time stack — the global
+    open-span table must not bleed into other test modules)."""
+    monitor.detach()
+    flight.uninstall()
+    with spans._lock:
+        spans._open.clear()
+    yield
+    monitor.detach()
+    flight.uninstall()
+    with spans._lock:
+        spans._open.clear()
+
+
+def _toy_recorder(n_steps=4, rank=0):
+    rec = monitor.Recorder(name="toy", meta={"process_index": rank,
+                                             "process_count": 1})
+    monitor.attach(rec)
+    run = spans.start("train/run", mode="toy")
+    for i in range(n_steps):
+        with rec.step():
+            rec.gauge("train/loss", 1.0 / (i + 1))
+            with spans.span("train/step", parent=run, idx=i):
+                pass
+    rec.observe("step_ms", 7.0)
+    return rec, run
+
+
+# -- snapshot ---------------------------------------------------------------
+
+def test_snapshot_noop_when_detached(tmp_path):
+    assert flight.snapshot("x", directory=str(tmp_path)) is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_trigger_inert_until_installed(tmp_path):
+    rec, _ = _toy_recorder()
+    flight._config["directory"] = str(tmp_path)
+    assert flight.trigger("early") is None          # not armed
+    assert list(tmp_path.iterdir()) == []
+    flight.install(directory=str(tmp_path), signals=(),
+                   atexit_dump=False)
+    path = flight.trigger("armed")
+    assert path is not None and os.path.exists(path)
+    spans.end(_)
+
+
+def test_snapshot_contents_and_open_span_stack(tmp_path):
+    rec, run = _toy_recorder(n_steps=3)
+    with spans.span("train/step", parent=run, idx=99):
+        path = flight.snapshot("explicit", directory=str(tmp_path))
+    assert os.path.basename(path) == "flight-0.jsonl"
+    header, events = load_jsonl(path)
+    assert header["flight"] is True
+    assert header["reason"] == "explicit"
+    assert header["meta"]["process_index"] == 0
+    assert header["dropped"] == rec.dropped == 0
+    assert header["open_spans"] == 2                # run + nested step
+    kinds = {e["kind"] for e in events}
+    assert {"step", "gauge", "span_start", "span_end", "histogram",
+            "open_span"} <= kinds
+    open_names = sorted(e["name"] for e in events
+                        if e["kind"] == "open_span")
+    assert open_names == ["train/run", "train/step"]
+    for ev in events:
+        if ev["kind"] == "open_span":
+            assert ev["age_s"] >= 0
+    spans.end(run)
+
+
+def test_snapshot_tail_bound(tmp_path):
+    rec, run = _toy_recorder(n_steps=50)
+    spans.end(run)
+    path = flight.snapshot("tail", directory=str(tmp_path),
+                           tail_events=10)
+    header, events = load_jsonl(path)
+    ring = [e for e in events
+            if e["kind"] not in ("histogram", "open_span")]
+    assert len(ring) == 10
+    # the newest events are the kept ones
+    assert ring[-1] == rec.records()[-1]
+    assert header["tail_events"] == 10
+
+
+def test_repeated_snapshot_overwrites_atomically(tmp_path):
+    _toy_recorder(n_steps=2)
+    p1 = flight.snapshot("first", directory=str(tmp_path))
+    p2 = flight.snapshot("second", directory=str(tmp_path))
+    assert p1 == p2
+    header, _ = load_jsonl(p2)
+    assert header["reason"] == "second"
+    assert not [p for p in os.listdir(tmp_path) if ".tmp" in p]
+
+
+# -- atomic dumps + truncation tolerance ------------------------------------
+
+def test_dump_jsonl_atomic_leaves_no_tmp(tmp_path):
+    rec, run = _toy_recorder(n_steps=2)
+    spans.end(run)
+    path = tmp_path / "run.jsonl"
+    n = rec.dump_jsonl(str(path))
+    assert n > 0 and path.exists()
+    assert not [p for p in os.listdir(tmp_path) if ".tmp" in p]
+    header, events = load_jsonl(str(path))
+    assert header["name"] == "toy" and len(events) == n
+    assert "open_spans" in header and "dropped" in header
+
+
+def test_load_jsonl_tolerates_truncated_trailing_line(tmp_path):
+    rec, run = _toy_recorder(n_steps=3)
+    spans.end(run)
+    path = tmp_path / "run.jsonl"
+    rec.dump_jsonl(str(path))
+    _, whole = load_jsonl(str(path))
+    with open(path, "a") as f:
+        f.write('{"kind": "gauge", "name": "train/lo')   # the torn append
+    with pytest.warns(RuntimeWarning, match="truncated trailing"):
+        header, events = load_jsonl(str(path))
+    assert len(events) == len(whole)
+    # mid-file corruption is damage, not truncation: still raises
+    lines = path.read_text().splitlines()
+    lines[2] = lines[2][:10]
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("\n".join(lines) + "\n")
+    with pytest.raises(json.JSONDecodeError):
+        load_jsonl(str(bad))
+
+
+def test_merge_tolerates_truncated_shard(tmp_path):
+    rec, run = _toy_recorder(n_steps=3)
+    spans.end(run)
+    shard = tmp_path / "monitor-0.jsonl"
+    rec.dump_jsonl(str(shard))
+    with open(shard, "a") as f:
+        f.write('{"kind": "step", "na')
+    from apex_tpu.monitor.merge import merge_shards
+    with pytest.warns(RuntimeWarning):
+        merged = merge_shards([str(shard)])
+    assert merged["ranks"] == [0]
+    assert merged["steps"]["by_rank"]["0"]["count"] == 3
+
+
+# -- install / signal chaining ----------------------------------------------
+
+def test_install_idempotent_and_uninstall():
+    assert flight.install(signals=(), atexit_dump=False) is True
+    assert flight.installed()
+    assert flight.install(signals=(), atexit_dump=False) is False
+    flight.uninstall()
+    assert not flight.installed()
+
+
+def test_signal_handler_chains_prior_handler(tmp_path):
+    hits = []
+
+    def prior(signum, frame):
+        hits.append(signum)
+
+    signal.signal(signal.SIGUSR1, prior)
+    try:
+        _toy_recorder(n_steps=2)
+        flight.install(directory=str(tmp_path),
+                       signals=(signal.SIGUSR1,), atexit_dump=False)
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.time() + 5
+        while not hits and time.time() < deadline:
+            time.sleep(0.01)
+        assert hits == [signal.SIGUSR1]             # prior handler ran
+        path = tmp_path / "flight-0.jsonl"
+        assert path.exists()
+        header, _ = load_jsonl(str(path))
+        assert header["reason"] == "signal:SIGUSR1"
+        flight.uninstall()
+        assert signal.getsignal(signal.SIGUSR1) is prior   # restored
+    finally:
+        flight.uninstall()
+        signal.signal(signal.SIGUSR1, signal.SIG_DFL)
+
+
+# -- watchdog-driven dumps --------------------------------------------------
+
+def test_fatal_watchdog_event_triggers_dump(tmp_path):
+    rec = monitor.Recorder(name="toy")
+    monitor.attach(rec)
+    flight.install(directory=str(tmp_path), signals=(),
+                   atexit_dump=False)
+    health.Watchdog(rec)
+    assert "nan" in health.FLIGHT_DUMP_EVENTS
+    with rec.step():
+        rec.gauge("train/loss", float("nan"))
+    path = tmp_path / "flight-0.jsonl"
+    assert path.exists()
+    header, events = load_jsonl(str(path))
+    assert header["reason"] == "health:nan"
+    assert any(e["kind"] == "health_event" and e["name"] == "nan"
+               for e in events)
+
+
+def test_nonfatal_watchdog_event_does_not_dump(tmp_path):
+    rec = monitor.Recorder(name="toy")
+    monitor.attach(rec)
+    flight.install(directory=str(tmp_path), signals=(),
+                   atexit_dump=False)
+    dog = health.Watchdog(rec)
+    dog._fire(rec, "loss_plateau", 1.0, "flat")     # not in the fatal set
+    assert not (tmp_path / "flight-0.jsonl").exists()
+
+
+# -- Prometheus blind spots -------------------------------------------------
+
+def test_export_blind_spots_dropped_and_open_spans():
+    from apex_tpu.monitor import export
+    rec = monitor.Recorder(name="toy", capacity=4)
+    monitor.attach(rec)
+    for i in range(10):
+        rec.gauge("g", i)
+    sid = spans.start("open/one")
+    snap = export.snapshot(recorder=rec)
+    assert snap["counters"]["monitor/dropped_events"] == rec.dropped > 0
+    assert snap["gauges"]["monitor/open_spans"] >= 1
+    text = export.render_prometheus(snap)
+    assert f"apex_monitor_dropped_events_total {rec.dropped}" in text
+    assert "apex_monitor_open_spans" in text
+    export.selfcheck_text(text, snap)
+    spans.end(sid)
+
+
+# -- merge CLI: globs + zero-match exit -------------------------------------
+
+def test_merge_cli_accepts_globs(tmp_path, capsys):
+    for rank in range(2):
+        rec = monitor.Recorder(name="toy",
+                               meta={"process_index": rank,
+                                     "process_count": 2})
+        with monitor.attached(rec):
+            with rec.step():
+                rec.gauge("train/loss", 1.0)
+        rec.dump_jsonl(str(tmp_path / f"monitor-{rank}.jsonl"))
+    rc = cli_main(["merge", str(tmp_path / "monitor-*.jsonl"), "--json"])
+    assert rc == 0
+    merged = json.loads(capsys.readouterr().out)
+    assert merged["ranks"] == [0, 1]
+
+
+def test_merge_directory_falls_back_to_flight_dumps(tmp_path, capsys):
+    """A killed run leaves only flight dumps; `merge dir/` must merge
+    them. A rank with BOTH a live shard and a flight dump contributes
+    only the shard (the dump is a tail of the same recorder — counting
+    both would double its collectives)."""
+    from apex_tpu.monitor.merge import find_shards
+    for rank in range(2):
+        rec, run = _toy_recorder(n_steps=2, rank=rank)
+        spans.end(run)
+        flight.snapshot("preempted", directory=str(tmp_path),
+                        recorder=rec)
+        monitor.detach()
+    rc = cli_main(["merge", str(tmp_path), "--json"])
+    assert rc == 0
+    merged = json.loads(capsys.readouterr().out)
+    assert merged["ranks"] == [0, 1]
+    # live shard wins over the flight dump for the same rank
+    rec, run = _toy_recorder(n_steps=2, rank=0)
+    spans.end(run)
+    rec.dump_jsonl(str(tmp_path / "monitor-0.jsonl"))
+    found = find_shards(str(tmp_path))
+    assert [os.path.basename(p) for p in found] == \
+        ["monitor-0.jsonl", "flight-1.jsonl"]
+
+
+def test_merge_cli_zero_matches_exits_nonzero(tmp_path, capsys):
+    rc = cli_main(["merge", str(tmp_path / "monitor-*.jsonl")])
+    assert rc == 2
+    assert "no monitor shards found" in capsys.readouterr().err
+    rc = cli_main(["merge", str(tmp_path)])          # empty directory
+    assert rc == 2
+    assert "no monitor shards found" in capsys.readouterr().err
+
+
+# -- the kill path (ISSUE 17 acceptance) ------------------------------------
+
+_TOY_LOOP = """\
+import os, sys, time
+from apex_tpu import monitor
+from apex_tpu.monitor import flight, spans
+
+rec = monitor.Recorder(name="toy-loop",
+                       meta={"process_index": 0, "process_count": 1})
+monitor.attach(rec)
+flight.install(directory=".", tail_events=256)
+run = spans.start("train/run", mode="kill-test")
+i = 0
+while True:
+    with rec.step():
+        rec.gauge("train/loss", 1.0 / (i + 1))
+        with spans.span("train/step", parent=run, idx=i):
+            time.sleep(0.02)
+    if i == 2:
+        print("READY", flush=True)
+    i += 1
+"""
+
+
+def test_sigterm_kill_leaves_flight_dump_with_open_span_stack(tmp_path):
+    """SIGTERM a stepping toy loop mid-run: the dump exists, parses,
+    round-trips through the merge and timeline CLIs, and holds the
+    open-span stack at kill time; the process still dies by signal
+    (the chained SIG_DFL disposition)."""
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _TOY_LOOP], cwd=str(tmp_path), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+    finally:
+        proc.kill()
+    assert proc.returncode == -signal.SIGTERM, proc.stderr.read()[-2000:]
+
+    dump = tmp_path / "flight-0.jsonl"
+    assert dump.exists(), "no flight dump after SIGTERM"
+    header, events = load_jsonl(str(dump))
+    assert header["flight"] is True
+    assert header["reason"] == "signal:SIGTERM"
+    opens = [e for e in events if e["kind"] == "open_span"]
+    names = {e["name"] for e in opens}
+    assert "train/run" in names                     # the kill-time stack
+    assert header["open_spans"] == len(opens) >= 1
+    assert any(e["kind"] == "step" for e in events)
+
+    # merge round trip (the dump is an ordinary rank-tagged shard)
+    rc = cli_main(["merge", str(dump), "--json"])
+    assert rc == 0
+
+    # timeline round trip: valid Chrome-trace JSON with the open span
+    # rendered as an unterminated B event
+    out = tmp_path / "trace.json"
+    rc = cli_main(["timeline", str(dump), "-o", str(out)])
+    assert rc == 0
+    trace = json.loads(out.read_text())
+    from apex_tpu.monitor.timeline import validate_timeline
+    assert validate_timeline(trace) == []
+    bs = [e for e in trace["traceEvents"]
+          if e["ph"] == "B" and e["args"].get("open_at_dump")]
+    assert any(e["name"] == "train/run" for e in bs)

@@ -1,0 +1,278 @@
+"""monitor.timeline: the cross-rank Chrome-trace/Perfetto exporter.
+
+Contracts (over hand-written synthetic shards, so every expected
+number is known exactly):
+
+- source loading: rank from header meta, else the ``monitor-N``/
+  ``flight-N`` filename, else enumeration; globs and directories
+  expand; a shard and a flight dump of the same rank fuse;
+- track shape: one process (pid) per rank with process_name metadata;
+  steps/compile/health threads; spans as nested duration events with
+  one thread per span tree; ``memory/hbm_*`` as counter tracks;
+  health events as instants; open spans as unterminated B events;
+- cross-rank clock alignment: a constant clock skew between ranks is
+  recovered (median over shared step indices) and removed from every
+  emitted timestamp;
+- straggler overlay: per-step ``step/over_median`` counters plus a
+  named instant on the slowest rank when it exceeds the ratio bar,
+  and the run-level ``merge_summaries`` skew block in the metadata;
+- the validator catches the malformed-trace shapes the CI gate
+  guards against (missing ph/ts/pid, non-monotonic per-track
+  timestamps, E without B, X without dur).
+"""
+
+import json
+import os
+
+from apex_tpu.monitor import timeline
+from apex_tpu.monitor.__main__ import main as cli_main
+from apex_tpu.monitor.recorder import json_line
+
+
+def _write_dump(path, events, meta=None, header_extra=None):
+    header = {"kind": "header", "name": "syn", "capacity": 1024,
+              "dropped": 0, "meta": meta or {}}
+    header.update(header_extra or {})
+    with open(path, "w") as f:
+        f.write(json_line(header) + "\n")
+        for ev in events:
+            f.write(json_line(ev) + "\n")
+    return str(path)
+
+
+def _steps(t0, n, dt=1.0, dur=0.5, skip=()):
+    return [{"kind": "step", "name": "step", "step": i,
+             "value": dur, "step_time_s": dur, "t": t0 + i * dt,
+             "gauges": {}, "counters": {}, "timers": {},
+             "collectives": {}}
+            for i in range(n) if i not in skip]
+
+
+def _events_of(trace, ph=None, pid=None):
+    evs = trace["traceEvents"]
+    if ph is not None:
+        evs = [e for e in evs if e["ph"] == ph]
+    if pid is not None:
+        evs = [e for e in evs if e["pid"] == pid]
+    return evs
+
+
+# -- source loading ---------------------------------------------------------
+
+def test_load_sources_rank_resolution(tmp_path):
+    _write_dump(tmp_path / "monitor-3.jsonl", _steps(0.0, 2))
+    _write_dump(tmp_path / "flight-1.jsonl", _steps(0.0, 2))
+    _write_dump(tmp_path / "whatever.jsonl", _steps(0.0, 2),
+                meta={"process_index": 7})
+    srcs = timeline.load_sources([str(tmp_path)])
+    # directory expansion finds the tagged files; the explicit file
+    # with header meta needs to be passed by name
+    assert [s["rank"] for s in srcs] == [1, 3]
+    srcs = timeline.load_sources([str(tmp_path / "whatever.jsonl")])
+    assert [s["rank"] for s in srcs] == [7]
+
+
+def test_load_sources_fuses_same_rank_and_dedupes(tmp_path):
+    shard = _write_dump(tmp_path / "monitor-0.jsonl", _steps(0.0, 2))
+    flightd = _write_dump(tmp_path / "flight-0.jsonl",
+                          [{"kind": "open_span", "name": "x",
+                            "value": 9, "parent": None, "t": 0.1,
+                            "age_s": 1.0}])
+    srcs = timeline.load_sources([shard, flightd,
+                                  str(tmp_path / "*.jsonl")])
+    assert len(srcs) == 1 and srcs[0]["rank"] == 0
+    assert len(srcs[0]["paths"]) == 2               # deduped glob hits
+    kinds = {e["kind"] for e in srcs[0]["events"]}
+    assert {"step", "open_span"} <= kinds
+
+
+# -- clock alignment --------------------------------------------------------
+
+def test_clock_alignment_recovers_constant_skew(tmp_path):
+    # rank 1's clock runs 5.25 s behind rank 0's on the same steps
+    a = _write_dump(tmp_path / "monitor-0.jsonl", _steps(10.0, 6))
+    b = _write_dump(tmp_path / "monitor-1.jsonl", _steps(10.0 - 5.25, 6))
+    srcs = timeline.load_sources([a, b])
+    offs = timeline.clock_offsets(srcs)
+    assert offs[0] == 0.0
+    assert abs(offs[1] - 5.25) < 1e-9
+    trace = timeline.build_timeline(srcs)
+    # aligned: the two ranks' step-0 X events start at the same ts
+    for idx in range(6):
+        ts = {e["pid"]: e["ts"] for e in _events_of(trace, ph="X")
+              if e["args"].get("step") == idx}
+        assert abs(ts[0] - ts[1]) < 1e-3
+    meta = trace["metadata"]["apex_tpu_timeline"]
+    assert abs(meta["clock_offset_s"]["1"] - 5.25) < 1e-9
+    # --no-align CLI twin: offsets zeroed
+    raw = timeline.build_timeline(srcs, align=False)
+    ts = {e["pid"]: e["ts"] for e in _events_of(raw, ph="X")
+          if e["args"].get("step") == 0}
+    assert abs(ts[0] - ts[1]) > 1e6                 # 5.25 s in us
+
+
+def test_alignment_without_shared_steps_is_identity(tmp_path):
+    a = _write_dump(tmp_path / "monitor-0.jsonl", _steps(0.0, 3))
+    b = _write_dump(tmp_path / "monitor-1.jsonl",
+                    _steps(100.0, 3, skip=(0, 1, 2)))   # no steps at all
+    srcs = timeline.load_sources([a, b])
+    assert timeline.clock_offsets(srcs) == {0: 0.0, 1: 0.0}
+
+
+# -- straggler overlay ------------------------------------------------------
+
+def test_straggler_overlay_names_slowest_rank(tmp_path):
+    # rank 1 runs a touch slow throughout (drives the run-level skew
+    # block) and blows past the straggler bar on step 2
+    slow = _steps(0.0, 4, dur=0.6)
+    slow[2] = dict(slow[2], value=1.5, step_time_s=1.5)   # 3x median
+    paths = [
+        _write_dump(tmp_path / "monitor-0.jsonl", _steps(0.0, 4, dur=0.5)),
+        _write_dump(tmp_path / "monitor-1.jsonl", slow),
+        _write_dump(tmp_path / "monitor-2.jsonl", _steps(0.0, 4, dur=0.5)),
+    ]
+    trace = timeline.build_timeline(timeline.load_sources(paths))
+    over = [e for e in _events_of(trace, ph="C")
+            if e["name"] == "step/over_median"]
+    assert len(over) == 12                          # 4 steps x 3 ranks
+    stragglers = [e for e in _events_of(trace, ph="i")
+                  if e["name"].startswith("straggler")]
+    assert len(stragglers) == 1
+    ev = stragglers[0]
+    assert ev["pid"] == 1 and ev["args"]["step"] == 2
+    assert "rank 1" in ev["name"] and "3.00x" in ev["name"]
+    assert ev["args"]["ratio"] == 3.0
+    skew = trace["metadata"]["apex_tpu_timeline"]["skew"]
+    assert skew["slowest_rank"] == 1                # merge machinery
+
+
+# -- track fusion -----------------------------------------------------------
+
+def test_tracks_spans_compile_hbm_health(tmp_path):
+    events = _steps(0.0, 2) + [
+        {"kind": "span_start", "name": "serve/request", "value": 1,
+         "parent": None, "t": 0.1},
+        {"kind": "span_start", "name": "serve/prefill", "value": 2,
+         "parent": 1, "t": 0.2},
+        {"kind": "span_end", "name": "serve/prefill", "value": 0.1,
+         "span": 2, "parent": 1, "t": 0.3},
+        {"kind": "span_end", "name": "serve/request", "value": 0.35,
+         "span": 1, "parent": None, "t": 0.45},
+        {"kind": "span_start", "name": "serve/request", "value": 3,
+         "parent": None, "t": 0.5},                 # still open
+        {"kind": "timer", "name": "jax/compile/backend", "value": 0.2,
+         "t": 0.9},
+        {"kind": "counter", "name": "jax/compile/cache_miss",
+         "value": 1, "total": 1, "t": 0.91},
+        {"kind": "gauge", "name": "memory/hbm_bytes_in_use",
+         "value": 123456.0, "t": 1.0},
+        {"kind": "gauge", "name": "memory/hbm_limit_bytes",
+         "value": 1e6, "t": 1.0},
+        {"kind": "health_event", "name": "hbm_high_water", "value": 0.9,
+         "severity": "critical", "diagnosis": "about to OOM", "t": 1.1},
+    ]
+    p = _write_dump(tmp_path / "monitor-0.jsonl", events)
+    trace = timeline.build_timeline(timeline.load_sources([p]))
+    assert timeline.validate_timeline(trace) == []
+
+    procs = [e for e in _events_of(trace, ph="M")
+             if e["name"] == "process_name"]
+    assert [e["args"]["name"] for e in procs] == ["rank 0"]
+
+    xs = _events_of(trace, ph="X")
+    by_name = {e["name"]: e for e in xs}
+    # nested span: child inside parent, one thread per span tree
+    req, pre = by_name["serve/request"], by_name["serve/prefill"]
+    assert req["tid"] == pre["tid"] >= timeline.TID_SPAN_BASE
+    assert req["ts"] <= pre["ts"]
+    assert pre["ts"] + pre["dur"] <= req["ts"] + req["dur"] + 1e-3
+    # compile timer anchored at start (t - duration)
+    comp = by_name["jax/compile/backend"]
+    assert comp["tid"] == timeline.TID_COMPILE
+    assert abs(comp["ts"] - 0.7e6) < 1e-3 and abs(comp["dur"] - 0.2e6) < 1e-3
+
+    opens = _events_of(trace, ph="B")
+    assert len(opens) == 1 and opens[0]["args"]["open_at_dump"]
+    assert opens[0]["name"] == "serve/request"
+
+    counters = {e["name"] for e in _events_of(trace, ph="C")}
+    assert {"memory/hbm_bytes_in_use", "memory/hbm_limit_bytes"} \
+        <= counters
+
+    instants = _events_of(trace, ph="i")
+    names = {e["name"] for e in instants}
+    assert "health/hbm_high_water" in names
+    assert "jax/compile/cache_miss" in names
+    health = [e for e in instants
+              if e["name"] == "health/hbm_high_water"][0]
+    assert health["args"]["severity"] == "critical"
+
+
+def test_open_span_record_from_flight_dump_renders_as_b(tmp_path):
+    p = _write_dump(tmp_path / "flight-2.jsonl", _steps(0.0, 1) + [
+        {"kind": "open_span", "name": "train/run", "value": 5,
+         "parent": None, "t": 0.01, "age_s": 3.2}],
+        header_extra={"flight": True, "reason": "signal:SIGTERM"})
+    trace = timeline.build_timeline(timeline.load_sources([p]))
+    assert timeline.validate_timeline(trace) == []
+    bs = _events_of(trace, ph="B", pid=2)
+    assert len(bs) == 1
+    assert bs[0]["name"] == "train/run"
+    assert bs[0]["args"]["age_s"] == 3.2
+
+
+# -- validator negatives ----------------------------------------------------
+
+def test_validator_flags_malformed_traces():
+    ok = {"traceEvents": [
+        {"ph": "X", "name": "a", "pid": 0, "tid": 1, "ts": 1.0,
+         "dur": 2.0}]}
+    assert timeline.validate_timeline(ok) == []
+    assert timeline.validate_timeline({}) == ["traceEvents missing or empty"]
+    errs = timeline.validate_timeline({"traceEvents": [
+        {"name": "no-ph", "pid": 0, "ts": 1.0},
+        {"ph": "X", "name": "no-pid", "ts": 1.0, "dur": 1.0},
+        {"ph": "X", "name": "no-ts", "pid": 0, "tid": 1},
+        {"ph": "X", "name": "no-dur", "pid": 0, "tid": 1, "ts": 5.0},
+        {"ph": "i", "name": "backwards", "pid": 0, "tid": 1, "ts": 1.0},
+        {"ph": "E", "name": "orphan", "pid": 0, "tid": 2, "ts": 9.0},
+    ]})
+    assert any("missing ph" in e for e in errs)
+    assert any("missing pid" in e for e in errs)
+    assert any("non-numeric ts" in e for e in errs)
+    assert any("dur" in e for e in errs)
+    assert any("ts" in e and "track" in e for e in errs)   # monotonic
+    assert any("E without matching B" in e for e in errs)
+
+
+def test_validator_allows_unterminated_b():
+    trace = {"traceEvents": [
+        {"ph": "B", "name": "open", "pid": 0, "tid": 1, "ts": 1.0},
+        {"ph": "B", "name": "nested", "pid": 0, "tid": 1, "ts": 2.0},
+        {"ph": "E", "name": "nested", "pid": 0, "tid": 1, "ts": 3.0},
+    ]}
+    assert timeline.validate_timeline(trace) == []
+
+
+# -- CLI --------------------------------------------------------------------
+
+def test_cli_timeline_round_trip(tmp_path, capsys):
+    a = _write_dump(tmp_path / "monitor-0.jsonl", _steps(0.0, 3))
+    b = _write_dump(tmp_path / "monitor-1.jsonl", _steps(2.0, 3))
+    out = tmp_path / "trace.json"
+    rc = cli_main(["timeline", str(tmp_path / "monitor-*.jsonl"),
+                   "-o", str(out)])
+    assert rc == 0
+    assert "2 rank(s)" in capsys.readouterr().out
+    trace = json.loads(out.read_text())
+    assert timeline.validate_timeline(trace) == []
+    assert {e["pid"] for e in trace["traceEvents"]} == {0, 1}
+    assert trace["displayTimeUnit"] == "ms"
+
+    rc = cli_main(["timeline", str(a), "--validate-only"])
+    assert rc == 0
+    assert "not written" in capsys.readouterr().out
+
+    rc = cli_main(["timeline", str(tmp_path / "nope-*.jsonl")])
+    assert rc == 2
+    assert "no recorder dumps found" in capsys.readouterr().err
