@@ -85,21 +85,22 @@ from apex_tpu.monitor.memory import MemorySampler  # noqa: F401
 from apex_tpu.monitor.profile import scope  # noqa: F401
 from apex_tpu.monitor.recorder import Recorder  # noqa: F401
 from apex_tpu.monitor.report import (  # noqa: F401
-    aggregate, load_jsonl, render_cross_host, render_memory, render_report,
-    render_serve, render_steps, selfcheck)
+    aggregate, load_jsonl, render_cross_host, render_fleet, render_memory,
+    render_report, render_serve, render_steps, selfcheck)
 from apex_tpu.monitor.spans import LogHistogram  # noqa: F401
 from apex_tpu.monitor.hooks import enabled, epoch  # noqa: F401
 
 
 def __getattr__(name: str):
-    # monitor.export is the ONLY lazily-imported submodule: it pulls in
-    # http.server, and the disabled-mode contract for the exporter is
-    # "no thread, no import cost" — a process that never exports never
-    # pays for the module (asserted by tests/test_export.py)
-    if name == "export":
+    # lazily-imported submodules: export pulls in http.server (and the
+    # disabled-mode contract for the exporter is "no thread, no import
+    # cost" — a process that never exports never pays for the module,
+    # asserted by tests/test_export.py); fleet/slo sit on top of export
+    # and inherit the same laziness so the guarantee survives
+    if name in ("export", "fleet", "slo"):
         import importlib
-        mod = importlib.import_module("apex_tpu.monitor.export")
-        globals()["export"] = mod
+        mod = importlib.import_module(f"apex_tpu.monitor.{name}")
+        globals()[name] = mod
         return mod
     raise AttributeError(f"module 'apex_tpu.monitor' has no attribute "
                          f"{name!r}")

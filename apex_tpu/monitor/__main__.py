@@ -9,6 +9,7 @@
                                       [--live] [--json]
     python -m apex_tpu.monitor regress RUNS... [--against BASELINE.json]
     python -m apex_tpu.monitor export run.jsonl [--once [--check]|--port N]
+    python -m apex_tpu.monitor fleet ENDPOINT... [--watch|--once] [--json]
     python -m apex_tpu.monitor selfcheck [--steps N]
 
 ``report`` renders the per-step and aggregate tables from a
@@ -38,9 +39,13 @@ regression. ``export`` renders a recorder JSONL dump/stream as
 Prometheus text exposition — ``--once`` to stdout (``--check``
 additionally parses the output back and asserts scrape == aggregate;
 the ``scripts/ci.sh`` export stage), otherwise served over HTTP with
-the file re-read per scrape. ``selfcheck`` records a synthetic 3-step
-amp run on CPU and asserts the dump → report round trip (used by
-``scripts/ci.sh``).
+the file re-read per scrape. ``fleet`` polls N replica exports — live
+``/metrics`` URLs and/or exposition files — and renders the per-replica
++ fleet table (counters summed, gauges min/max/sum, histograms merged
+bucket-wise) with SLO burn-rate alerts and autoscale decisions;
+``--once`` exits non-zero when an alert fires (the CI fleet stage).
+``selfcheck`` records a synthetic 3-step amp run on CPU and asserts
+the dump → report round trip (used by ``scripts/ci.sh``).
 
 ``profile`` also reports **MFU** (model FLOPs utilization): the
 analytic step FLOPs divided by measured wall time and the
@@ -197,6 +202,24 @@ def main(argv=None) -> int:
     pe.add_argument("--port", type=int, default=9464)
     pe.add_argument("--addr", default="127.0.0.1")
 
+    pf = sub.add_parser("fleet",
+                        help="poll replica exports; fleet aggregate + "
+                             "SLO burn-rate alerts + scale decisions")
+    pf.add_argument("endpoints", nargs="+",
+                    help="replica /metrics URLs and/or exposition "
+                         "file paths")
+    pf.add_argument("--once", action="store_true",
+                    help="poll once and exit (non-zero when an SLO "
+                         "alert fires) — the default mode")
+    pf.add_argument("--watch", action="store_true",
+                    help="poll repeatedly until interrupted")
+    pf.add_argument("--json", action="store_true",
+                    help="print each poll view as one JSON line")
+    pf.add_argument("--interval", type=float, default=10.0,
+                    help="--watch poll interval seconds")
+    pf.add_argument("--timeout", type=float, default=2.0,
+                    help="per-replica scrape timeout seconds")
+
     ps = sub.add_parser("selfcheck",
                         help="record a synthetic run; assert round-trip")
     ps.add_argument("--steps", type=int, default=3)
@@ -291,6 +314,10 @@ def main(argv=None) -> int:
     if args.cmd == "export":
         from apex_tpu.monitor import export as export_mod
         return export_mod.main(args)
+
+    if args.cmd == "fleet":
+        from apex_tpu.monitor import fleet as fleet_mod
+        return fleet_mod.main(args)
 
     if args.cmd == "profile":
         return _run_profile(args)

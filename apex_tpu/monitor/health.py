@@ -92,6 +92,10 @@ HEALTH_EVENT_KINDS = (
     "loader_starvation", "straggler",
     "kv_pool_exhaustion", "eviction_storm", "admission_starvation",
     "hbm_high_water", "memory_leak", "recompile_storm",
+    # fleet-level conditions (apex_tpu.monitor.slo/fleet): SLO error
+    # budget burning too fast, and autoscale decisions derived from
+    # fleet-wide pressure signals
+    "slo_alert", "scale_decision",
 )
 
 # Conditions fatal enough that the process may not get another chance
@@ -231,6 +235,10 @@ class Watchdog:
               severity: str = "warn", **details) -> dict:
         ev = rec.emit("health_event", name, value, severity=severity,
                       diagnosis=diagnosis, **details)
+        # shadow counter: health firings become scrapeable
+        # (`apex_health_<name>_total` in the Prometheus exposition) —
+        # the fleet autoscale decision engine sums these across replicas
+        rec.counter(f"health/{name}")
         self.events.append(ev)
         if self.on_event is not None:
             try:

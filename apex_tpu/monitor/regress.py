@@ -101,6 +101,11 @@ def suffix_unit(name: str) -> str:
         return "bytes"
     if "occupancy" in name:
         return "fraction (pool occupancy)"
+    # fleet metrics (r18 on: monitor.fleet bench keys — replica/alert/
+    # decision counts gate as counts, latency/goodput resolve above)
+    if name.endswith(("_replicas", "_replicas_up", "_alerts",
+                      "_decisions", "_polls")):
+        return "count"
     return ""
 
 
@@ -250,8 +255,10 @@ def metric_direction(name: str, unit: str) -> Optional[str]:
             or "bytes" in name or "loss" in name or base == "loss" \
             or "ttft" in name or "queue_wait" in name \
             or "occupancy" in name or "mispredict" in name \
-            or "utilization" in name:
+            or "utilization" in name or "alert" in name:
         return "lower"
+    if name.endswith(("_replicas_up",)):
+        return "higher"
     if "/sec" in base or base in ("mfu", "ratio") or "per_sec" in name \
             or "speedup" in name or "mfu" in name or name == "vs_baseline" \
             or "goodput" in name or "capacity_ratio" in name:

@@ -1,0 +1,385 @@
+"""Declarative SLOs, multi-window burn-rate alerting, and autoscale
+decisions over a fleet telemetry view.
+
+This is the policy half of the fleet layer (:mod:`apex_tpu.monitor.
+fleet` is the mechanism half: scraping + aggregation). It answers two
+questions every poll:
+
+1. **Is the fleet meeting its objectives?** A declarative
+   :class:`SLO` table (objective + error budget) is evaluated with
+   multi-window multi-burn-rate alerting: for each window pair the
+   error-budget burn rate (observed error fraction / budgeted error
+   fraction) must exceed the pair's threshold in BOTH the short and
+   the long window before an alert fires — the short window makes the
+   alert fast, the long window keeps a transient blip from paging.
+   Defaults are the classic fast ``5m/1h @ 14.4x`` (page) and slow
+   ``30m/6h @ 6x`` (ticket) pairs. Error fractions come from
+   cumulative-histogram DELTAS between polls (the fraction of *new*
+   samples over the objective), so a long-healthy fleet with one bad
+   minute burns exactly that minute, and a single-poll ``--once``
+   evaluation degrades to "the whole run is the window" — a violating
+   fixture still fires, a compliant one stays silent.
+
+2. **Should the fleet change size?** :class:`AutoscaleDecider` turns
+   fleet-wide pressure signals — ``health/kv_pool_exhaustion`` /
+   ``admission_starvation`` / ``eviction_storm`` counter deltas (the
+   Watchdog's shadow counters, summed across replicas), per-replica
+   pool-occupancy headroom, and queue-depth trends — into typed
+   ``scale_decision`` events (``scale_out`` / ``scale_in`` /
+   ``rebalance``), each carrying a quoted rationale naming the numbers
+   that forced it. Decisions are advisory events (the input a router/
+   autoscaler consumes); nothing here starts or stops replicas.
+
+Both alert and decision ride the existing health-event schema
+(``kind="health_event"``, names registered in
+``health.HEALTH_EVENT_KINDS``), so ``report``/``merge``/``timeline``/
+``flight`` consume them with zero new plumbing. Pure stdlib, no jax
+at import (APX001).
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from typing import Optional, Sequence
+
+__all__ = ["SLO", "DEFAULT_SLOS", "DEFAULT_WINDOWS", "SLOEvaluator",
+           "AutoscaleDecider"]
+
+
+class SLO:
+    """One objective over a fleet metric.
+
+    ``kind="histogram"`` (default): ``metric`` names an exposition
+    histogram base (e.g. ``apex_serve_ttft_ms``) and the objective is
+    a latency bound — a new sample is an *error* when it lands above
+    ``objective`` (judged conservatively at bucket granularity: a
+    bucket is "good" only when its whole range is ≤ the objective).
+
+    ``kind="gauge"``: ``metric`` names a gauge and the objective is a
+    floor (``op=">="``, e.g. goodput/chip ≥ Y) or ceiling; the error
+    fraction is the fraction of live replicas violating it.
+    """
+
+    def __init__(self, name: str, metric: str, *, objective: float,
+                 kind: str = "histogram", op: str = "<=",
+                 error_budget: float = 0.01, description: str = ""):
+        if kind not in ("histogram", "gauge"):
+            raise ValueError(f"unknown SLO kind {kind!r}")
+        if op not in ("<=", ">="):
+            raise ValueError(f"unknown SLO op {op!r}")
+        if not (0.0 < error_budget <= 1.0):
+            raise ValueError("error_budget must be in (0, 1]")
+        self.name = name
+        self.metric = metric
+        self.objective = float(objective)
+        self.kind = kind
+        self.op = op
+        self.error_budget = float(error_budget)
+        self.description = description or \
+            f"{metric} {op} {objective} ({kind})"
+
+    def __repr__(self):
+        return (f"SLO({self.name!r}, {self.metric!r}, "
+                f"op={self.op!r}, objective={self.objective})")
+
+
+# Latency objectives generous enough that warmed CPU-CI traffic never
+# trips them (compile time is excluded by the bench warmup convention);
+# a starved fixture (queue waits in the minutes) blows through all of
+# them. Budgets are 1%: one violating sample in a hundred is budgeted,
+# a fully-violating interval burns at 100x.
+DEFAULT_SLOS = (
+    SLO("ttft_p99", "apex_serve_ttft_ms", objective=10_000.0,
+        description="time-to-first-token <= 10 s"),
+    SLO("queue_wait_p99", "apex_serve_queue_wait_ms", objective=30_000.0,
+        description="admission queue wait <= 30 s"),
+    SLO("token_latency_p99", "apex_serve_token_latency_ms",
+        objective=5_000.0, description="per-token latency <= 5 s"),
+)
+
+# (name, short_s, long_s, burn threshold, severity): both windows must
+# burn above the threshold to fire. 14.4x on a 1% budget means ~2% of
+# a 30-day budget gone in one hour — the SRE-workbook page pair; 6x is
+# the slow ticket pair.
+DEFAULT_WINDOWS = (
+    {"name": "fast", "short_s": 300.0, "long_s": 3600.0,
+     "burn": 14.4, "severity": "error"},
+    {"name": "slow", "short_s": 1800.0, "long_s": 21600.0,
+     "burn": 6.0, "severity": "warn"},
+)
+
+
+def _hist_good_count(snap: dict, objective: float) -> int:
+    """Samples of a :meth:`LogHistogram.snapshot` payload known to be
+    ≤ ``objective``: the underflow bin plus every bucket whose UPPER
+    edge is ≤ the objective (a bucket straddling the objective counts
+    as bad — conservative at the histogram's ~12% resolution)."""
+    lo = float(snap["lo"])
+    bpd = int(snap["buckets_per_decade"])
+    good = int(snap.get("underflow", 0))
+    for i, c in (snap.get("counts") or {}).items():
+        upper = lo * 10.0 ** ((int(i) + 1) / bpd)
+        if upper <= objective * (1.0 + 1e-9):
+            good += int(c)
+    return good
+
+
+class SLOEvaluator:
+    """Multi-window burn-rate evaluation of an :class:`SLO` table.
+
+    Feed it one fleet view per poll (:meth:`observe`); it returns the
+    alerts newly firing at that poll. Per (slo, window-pair) hysteresis:
+    a firing pair stays latched until its short-window burn drops back
+    under the threshold, so a sustained violation alerts once, not
+    once per poll.
+    """
+
+    def __init__(self, slos: Optional[Sequence[SLO]] = None,
+                 windows=None):
+        self.slos = tuple(slos if slos is not None else DEFAULT_SLOS)
+        self.windows = tuple(windows if windows is not None
+                             else DEFAULT_WINDOWS)
+        horizon = max((w["long_s"] for w in self.windows), default=0.0)
+        self._horizon_s = float(horizon)
+        # slo.name -> deque[(t, error_fraction)]
+        self._samples: dict = collections.defaultdict(collections.deque)
+        # slo.name -> (cum_count, cum_good) basis for histogram deltas
+        self._basis: dict = {}
+        self._latched: set = set()          # (slo.name, window name)
+
+    # -- per-poll sampling -------------------------------------------------
+    def _error_fraction(self, slo: SLO, fleet: dict) -> Optional[float]:
+        if slo.kind == "histogram":
+            snap = (fleet.get("histograms") or {}).get(slo.metric)
+            if not snap:
+                return None
+            count = int(snap.get("count", 0))
+            good = _hist_good_count(snap, slo.objective)
+            if slo.op == ">=":            # floor on a latency is odd but legal
+                good = count - good
+            pc, pg = self._basis.get(slo.name, (0, 0))
+            self._basis[slo.name] = (count, good)
+            d_count, d_good = count - pc, good - pg
+            if d_count <= 0:
+                return None               # no new samples: no evidence
+            return min(1.0, max(0.0, 1.0 - d_good / d_count))
+        view = (fleet.get("gauges") or {}).get(slo.metric)
+        if not view:
+            return None
+        vals = [v for v in (view.get("by_replica") or {}).values()
+                if isinstance(v, (int, float)) and math.isfinite(v)]
+        if not vals:
+            return None
+        if slo.op == ">=":
+            bad = sum(1 for v in vals if v < slo.objective)
+        else:
+            bad = sum(1 for v in vals if v > slo.objective)
+        return bad / len(vals)
+
+    def _burn(self, name: str, t: float, window_s: float,
+              budget: float) -> Optional[float]:
+        xs = [f for (ts, f) in self._samples[name] if ts >= t - window_s]
+        if not xs:
+            return None
+        return (sum(xs) / len(xs)) / budget
+
+    def observe(self, fleet: dict, t: float) -> list:
+        """Record this poll's error fractions and return newly-firing
+        alert dicts (empty when every objective is inside budget)."""
+        alerts = []
+        for slo in self.slos:
+            frac = self._error_fraction(slo, fleet)
+            dq = self._samples[slo.name]
+            if frac is not None:
+                dq.append((t, frac))
+            while dq and dq[0][0] < t - self._horizon_s:
+                dq.popleft()
+            for w in self.windows:
+                key = (slo.name, w["name"])
+                bs = self._burn(slo.name, t, w["short_s"], slo.error_budget)
+                bl = self._burn(slo.name, t, w["long_s"], slo.error_budget)
+                if bs is None or bl is None:
+                    continue
+                firing = bs >= w["burn"] and bl >= w["burn"]
+                if not firing:
+                    if bs < w["burn"]:
+                        self._latched.discard(key)
+                    continue
+                if key in self._latched:
+                    continue
+                self._latched.add(key)
+                alerts.append({
+                    "slo": slo.name, "metric": slo.metric,
+                    "window": w["name"], "severity": w["severity"],
+                    "burn_short": round(bs, 2), "burn_long": round(bl, 2),
+                    "threshold": w["burn"],
+                    "error_budget": slo.error_budget,
+                    "diagnosis": (
+                        f"SLO '{slo.name}' ({slo.description}) burning "
+                        f"error budget at {bs:.1f}x in the {w['name']} "
+                        f"window pair ({int(w['short_s'])}s/"
+                        f"{int(w['long_s'])}s, threshold {w['burn']}x, "
+                        f"budget {slo.error_budget:g})"),
+                })
+        return alerts
+
+
+class AutoscaleDecider:
+    """Fleet pressure → typed scale decisions with quoted rationale.
+
+    Inputs per poll (all read from the fleet view, nothing live):
+
+    - **pressure counters**: deltas of the Watchdog shadow counters
+      ``apex_health_{kv_pool_exhaustion,admission_starvation,
+      eviction_storm}_total`` summed across replicas — new firings
+      since the last poll mean the pool/admission path is saturating;
+    - **headroom**: per-replica ``1 - pages_in_use/pages_total``;
+    - **queue trend**: the fleet-summed ``apex_serve_queue_depth``
+      history (rising queues with pressure = scale out NOW);
+    - **fast-burn alerts** from the :class:`SLOEvaluator`.
+
+    Rules (first match wins): new pressure or a fast-burn alert →
+    ``scale_out``; a wide per-replica occupancy spread with a hot
+    replica → ``rebalance``; ``scale_in_idle_polls`` consecutive
+    fully-idle polls (empty queues, ample headroom, no pressure) →
+    ``scale_in`` — so a single ``--once`` poll can demand scale-out
+    but never scale-in. Repeat decisions are suppressed for
+    ``cooldown_polls`` unless new pressure arrives.
+    """
+
+    PRESSURE_COUNTERS = ("apex_health_kv_pool_exhaustion_total",
+                         "apex_health_admission_starvation_total",
+                         "apex_health_eviction_storm_total")
+
+    def __init__(self, *, min_headroom: float = 0.1,
+                 scale_in_headroom: float = 0.8,
+                 scale_in_idle_polls: int = 3,
+                 imbalance: float = 0.5,
+                 cooldown_polls: int = 5):
+        self.min_headroom = float(min_headroom)
+        self.scale_in_headroom = float(scale_in_headroom)
+        self.scale_in_idle_polls = int(scale_in_idle_polls)
+        self.imbalance = float(imbalance)
+        self.cooldown_polls = int(cooldown_polls)
+        self._prev_pressure: dict = {}
+        self._queue_hist: collections.deque = collections.deque(maxlen=8)
+        self._idle_streak = 0
+        self._polls = 0
+        self._last: Optional[tuple] = None    # (decision, poll index)
+
+    # -- input extraction --------------------------------------------------
+    def _pressure_delta(self, fleet: dict) -> dict:
+        counters = fleet.get("counters") or {}
+        delta = {}
+        for k in self.PRESSURE_COUNTERS:
+            cur = float(counters.get(k, 0.0))
+            d = cur - self._prev_pressure.get(k, 0.0)
+            self._prev_pressure[k] = cur
+            if d > 0:
+                delta[k] = d
+        return delta
+
+    @staticmethod
+    def _occupancy(fleet: dict) -> dict:
+        gauges = fleet.get("gauges") or {}
+        used = (gauges.get("apex_serve_pages_in_use") or {}) \
+            .get("by_replica") or {}
+        total = (gauges.get("apex_serve_pages_total") or {}) \
+            .get("by_replica") or {}
+        occ = {}
+        for rid, tot in total.items():
+            if tot and rid in used:
+                occ[rid] = used[rid] / tot
+        return occ
+
+    def _cooling(self, decision: str) -> bool:
+        if self._last is None:
+            return False
+        last, at = self._last
+        return last == decision and self._polls - at < self.cooldown_polls
+
+    def decide(self, fleet: dict, alerts: Sequence[dict]) -> Optional[dict]:
+        """One decision (or ``None``) for this poll's fleet view."""
+        self._polls += 1
+        pressure = self._pressure_delta(fleet)
+        occ = self._occupancy(fleet)
+        headroom = {rid: 1.0 - o for rid, o in occ.items()}
+        min_head = min(headroom.values()) if headroom else None
+        gauges = fleet.get("gauges") or {}
+        qsum = (gauges.get("apex_serve_queue_depth") or {}).get("sum", 0.0)
+        self._queue_hist.append(float(qsum or 0.0))
+        q = list(self._queue_hist)
+        rising = len(q) >= 3 and q[-1] > q[-2] > q[-3] and q[-1] > 0
+        fast = [a for a in alerts if a.get("window") == "fast"]
+
+        def _emit(decision, severity, rationale, **inputs):
+            self._last = (decision, self._polls)
+            return {"decision": decision, "severity": severity,
+                    "rationale": rationale,
+                    "inputs": {"pressure": pressure,
+                               "min_headroom": min_head,
+                               "queue_depth_sum": qsum, **inputs}}
+
+        if pressure or fast:
+            if not pressure and self._cooling("scale_out"):
+                return None
+            why = []
+            for k, d in pressure.items():
+                short = k[len("apex_health_"):-len("_total")]
+                worst = self._worst_replica(fleet, k)
+                why.append(f"{int(d)} new {short} firing(s)"
+                           + (f" (worst: {worst})" if worst else ""))
+            for a in fast:
+                why.append(f"fast-burn SLO alert '{a['slo']}' at "
+                           f"{a['burn_short']}x budget")
+            if min_head is not None:
+                why.append(f"min replica headroom {min_head:.0%}")
+            if rising:
+                why.append(f"queue depth rising (now {qsum:g})")
+            self._idle_streak = 0
+            return _emit(
+                "scale_out", "warn",
+                "scale out: " + "; ".join(why),
+                alerts=[a["slo"] for a in fast])
+
+        if len(occ) >= 2:
+            hot = max(occ, key=occ.get)
+            cold = min(occ, key=occ.get)
+            spread = occ[hot] - occ[cold]
+            if spread > self.imbalance and occ[hot] > 0.7 \
+                    and not self._cooling("rebalance"):
+                self._idle_streak = 0
+                return _emit(
+                    "rebalance", "warn",
+                    f"rebalance: pool occupancy spread {spread:.0%} "
+                    f"(hottest replica '{hot}' at {occ[hot]:.0%}, "
+                    f"coldest '{cold}' at {occ[cold]:.0%})",
+                    hot=hot, cold=cold, spread=round(spread, 3))
+
+        idle = (not pressure and not alerts and (qsum or 0.0) == 0.0
+                and (min_head is None or min_head >= self.scale_in_headroom))
+        if idle:
+            self._idle_streak += 1
+            if self._idle_streak >= self.scale_in_idle_polls \
+                    and not self._cooling("scale_in"):
+                return _emit(
+                    "scale_in", "info",
+                    f"scale in: {self._idle_streak} consecutive idle "
+                    f"polls (queues empty, min headroom "
+                    f"{min_head:.0%})" if min_head is not None else
+                    f"scale in: {self._idle_streak} consecutive idle "
+                    "polls (queues empty)",
+                    idle_polls=self._idle_streak)
+        else:
+            self._idle_streak = 0
+        return None
+
+    @staticmethod
+    def _worst_replica(fleet: dict, counter: str) -> Optional[str]:
+        """The replica contributing most to a pressure counter, when
+        the fleet view kept per-replica counter detail."""
+        by = (fleet.get("counters_by_replica") or {}).get(counter) or {}
+        if not by:
+            return None
+        return max(by, key=by.get)

@@ -2152,6 +2152,116 @@ def _bench_serve_decode():
     return out
 
 
+def _bench_serve_fleet():
+    """The multi-replica fleet layer (monitor.fleet, ISSUE 18): two
+    live ``ServeEngine`` replicas on threads — one healthy, one with a
+    deliberately tiny KV pool watched by a per-replica Watchdog — each
+    exporting ``/metrics`` on an ephemeral port, scraped by a
+    ``FleetPoller`` through the thread-routing recorder harness. Same
+    code in smoke and full: everything is host-side thread plumbing at
+    the tiny-GPT shape.
+
+    Asserted (the PR's acceptance criteria, enforced per-run):
+    - fleet goodput == sum of the per-replica goodput gauges (the
+      aggregation layer must not invent or lose throughput);
+    - the merged-histogram p99 lands within the documented ~12% bucket
+      band of a direct ``LogHistogram.merge`` of the per-replica
+      recorder snapshots (fleet percentiles come from ONE merged
+      histogram, and the scrape round trip must not corrupt it);
+    - the tiny-pool replica's pressure (Watchdog shadow counters,
+      scraped fleet-wide) forces a ``scale_out`` decision in-section.
+    """
+    import numpy as np
+    import jax as _jax
+    import jax.numpy as jnp
+    from apex_tpu import monitor, serve
+    from apex_tpu.models.gpt import GPT, GPTConfig
+    from apex_tpu.monitor import fleet as fleet_mod
+    from apex_tpu.monitor.recorder import Recorder
+    from apex_tpu.monitor.spans import LogHistogram
+
+    cfg = GPTConfig(vocab_size=256, max_seq_len=256, hidden_size=64,
+                    num_layers=2, num_heads=4, dtype=jnp.float32)
+    params = GPT(cfg).init(_jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.RandomState(11)
+    healthy = serve.ServeEngine(cfg, params, num_pages=64,
+                                max_seq_len=128, max_prompt_len=32,
+                                max_batch=4, replica_id="healthy")
+    # the forced-pressure replica: pool sized below its working set, so
+    # its Watchdog must fire kv_pool_exhaustion (scraped fleet-wide as
+    # apex_health_*_total — the decision engine's scale_out evidence)
+    tiny = serve.ServeEngine(cfg, params, num_pages=8, max_seq_len=32,
+                             max_prompt_len=8, page_size=4, max_batch=3,
+                             replica_id="tinypool")
+    reqs_healthy = [(list(rng.randint(0, 256, rng.randint(8, 25))),
+                     int(rng.randint(16, 33))) for _ in range(4)]
+    reqs_tiny = [(list(rng.randint(0, 256, 6)), 16) for _ in range(3)]
+    fleet = fleet_mod.LocalFleet(
+        [healthy, tiny],
+        watchdogs={"tinypool": dict(eviction_window=20, eviction_trips=3,
+                                    kv_pool_min_free_fraction=0.2)})
+    ctl = Recorder(traced_hooks=False, name="fleet-bench")
+    with monitor.attached(fleet.router):
+        fleet.start({"healthy": reqs_healthy, "tinypool": reqs_tiny})
+        fleet.wait_ready(timeout=120.0)
+        poller = fleet_mod.FleetPoller(fleet.replica_set, recorder=ctl,
+                                       timeout_s=10.0)
+        deadline = time.perf_counter() + 180.0
+        while not fleet.drained():
+            poller.poll_once()              # scrape while serving
+            assert time.perf_counter() < deadline, "fleet never drained"
+            time.sleep(0.05)
+        view = poller.poll_once()           # post-drain, endpoints held
+        outputs = fleet.join()
+    assert view["n_up"] == 2, view["replicas"]
+
+    # counters sum exactly across the fleet
+    n_tokens = {rid: sum(len(v) for v in outs.values())
+                for rid, outs in outputs.items()}
+    total = sum(n_tokens.values())
+    got = view["counters"]["apex_serve_tokens_generated_total"]
+    assert got == total, f"fleet counter {got} != per-replica sum {total}"
+
+    # fleet goodput == sum of per-replica goodput gauges
+    gview = view["gauges"]["apex_serve_goodput_tokens_per_sec_chip"]
+    per_replica = sum(
+        fleet.recorders[rid].gauges()["serve/goodput_tokens_per_sec_chip"]
+        for rid in ("healthy", "tinypool"))
+    assert abs(gview["sum"] - per_replica) <= 1e-6 * per_replica, \
+        f"fleet goodput {gview['sum']} != replica sum {per_replica}"
+
+    # merged p99 within the half-bucket band of the direct merge
+    direct = LogHistogram.merge(*[
+        fleet.recorders[rid].histograms()[
+            "serve/token_latency_ms"].snapshot()
+        for rid in ("healthy", "tinypool")])
+    band = 10.0 ** (1.0 / (2 * 10))
+    merged_p99 = view["hist_summary"]["apex_serve_token_latency_ms"]["p99"]
+    direct_p99 = direct.percentile(99)
+    assert direct_p99 / band <= merged_p99 <= direct_p99 * band, \
+        f"merged p99 {merged_p99} outside band of direct {direct_p99}"
+
+    # the tiny-pool replica's pressure forced a scale_out decision
+    scale_outs = [d for d in poller.decisions
+                  if d["decision"] == "scale_out"]
+    assert scale_outs, \
+        f"no scale_out despite forced pool pressure: {poller.decisions}"
+    assert "tinypool" in scale_outs[0]["rationale"], \
+        scale_outs[0]["rationale"]
+
+    return {"fleet_replicas": view["n_replicas"],
+            "fleet_replicas_up": view["n_up"],
+            "fleet_polls": poller.polls,
+            "fleet_tokens_generated": int(got),
+            "fleet_goodput_tokens_per_sec_chip": round(gview["sum"], 1),
+            "fleet_merged_p99_token_ms": round(merged_p99, 3),
+            "fleet_direct_p99_token_ms": round(direct_p99, 3),
+            "fleet_slo_alerts": len(poller.alerts),
+            "fleet_scale_out_decisions": len(scale_outs),
+            "fleet_scale_decisions": len(poller.decisions)}
+
+
 def _bench_memory():
     """The unified memory evidence (monitor.memory, ISSUE 15): every
     byte claim in this section is derived THROUGH the memory layer —
@@ -2537,6 +2647,21 @@ _METRIC_UNITS = {
     "memory_zero_world_size": "devices (mesh world)",
     "memory_vmem_configs_checked": "count",
     "memory_vmem_mispredicts": "count (envelope under-predictions)",
+    # the r18 serve_fleet section (monitor.fleet): live two-replica
+    # scrape aggregation — counts + merged-percentile evidence keys
+    "fleet_replicas": "count (registered replicas)",
+    "fleet_replicas_up": "count (live at final poll)",
+    "fleet_polls": "count (scrape rounds)",
+    "fleet_tokens_generated": "count (fleet-summed counter)",
+    "fleet_goodput_tokens_per_sec_chip":
+        "tokens/sec/chip (goodput, fleet sum)",
+    "fleet_merged_p99_token_ms":
+        "ms (p99 of the scrape-merged fleet histogram)",
+    "fleet_direct_p99_token_ms":
+        "ms (p99 of the in-process LogHistogram.merge — drift anchor)",
+    "fleet_slo_alerts": "count (burn-rate alerts over the run)",
+    "fleet_scale_out_decisions": "count (autoscale decisions)",
+    "fleet_scale_decisions": "count (autoscale decisions, all kinds)",
 }
 
 
@@ -2755,6 +2880,7 @@ def _sections_full(ctx: dict, rec) -> list:
         ("multi_tensor_update", 240, _bench_multi_tensor_update),
         ("profile", 120, _bench_profile),
         ("serve_decode", 300, _bench_serve_decode),
+        ("serve_fleet", 300, _bench_serve_fleet),
         ("memory", 300, _bench_memory),
         ("monitor", 120, lambda: _monitor_extras(rec)),
     ]
@@ -2767,7 +2893,7 @@ SMOKE_EXPECTED = ("smoke_mlp_amp", "smoke_fused_adam",
                   "smoke_noop_dispatch", "tp_overlap", "ddp_bucket_overlap",
                   "pp_zero_bubble", "zero_sharded_step", "fp8_step",
                   "autotune", "fused_ln", "multi_tensor_update",
-                  "profile", "serve_decode", "memory",
+                  "profile", "serve_decode", "serve_fleet", "memory",
                   "smoke_timeout_probe", "monitor")
 
 
@@ -2876,6 +3002,10 @@ def _sections_smoke(ctx: dict, rec) -> list:
         # and the fp8 pool accounting hold on any backend (the engine
         # picks the kernel paths on TPU, the XLA references elsewhere)
         ("serve_decode", 240, _bench_serve_decode),
+        # same code in smoke and full: the fleet harness is host-side
+        # thread plumbing at the tiny-GPT shape — two live replicas,
+        # ephemeral /metrics endpoints, a real scrape loop
+        ("serve_fleet", 240, _bench_serve_fleet),
         # same code in smoke and full: residency and pool math are
         # backend-independent, the analytic walk is abstract, and the
         # sampler degrades to the nominal cpu row by design

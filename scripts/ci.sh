@@ -176,7 +176,7 @@ for line in open(sys.argv[1]):
 missing = {"tp_overlap", "ddp_bucket_overlap", "pp_zero_bubble",
            "zero_sharded_step", "fp8_step", "autotune", "fused_ln",
            "multi_tensor_update", "profile", "serve_decode",
-           "memory"} - seen
+           "serve_fleet", "memory"} - seen
 if missing:
     print(f"ci: sections missing from bench stream: {sorted(missing)}")
     raise SystemExit(1)
@@ -213,8 +213,8 @@ if missing_mem and not any(k.endswith(("_error", "_skipped"))
     raise SystemExit(1)
 print("ci: tp_overlap + ddp_bucket_overlap + pp_zero_bubble + "
       "zero_sharded_step + fp8_step + autotune + fused_ln + "
-      "multi_tensor_update + profile + serve_decode + memory "
-      "present in bench stream (serve SLO keys span-derived, "
+      "multi_tensor_update + profile + serve_decode + serve_fleet + "
+      "memory present in bench stream (serve SLO keys span-derived, "
       "memory byte keys re-derived through monitor.memory)")
 EOF
 
@@ -236,6 +236,76 @@ grep -q "^MFU: " /tmp/ci_profile_mfu.txt || {
 # export of the smoke stream has to carry memory/ metrics
 grep -q "^apex_memory_" /tmp/ci_export.txt || {
   echo "ci: export scrape carries no memory/ gauges"; fail=1; }
+
+echo "== ci: monitor fleet (multi-replica aggregation + SLO burn-rate gate) =="
+# both directions of the alert contract, driver-side: a healthy
+# two-replica file pair must aggregate clean and exit 0; a starved
+# replica (queue waits of 65-90 s against the 30 s objective + the
+# admission_starvation pressure counter) must flip the exit code AND
+# render the alert + scale_out decision — an alerting layer that can't
+# fire, or that cries wolf on healthy traffic, must not read green
+python - <<'EOF' || fail=1
+from apex_tpu.monitor import export
+from apex_tpu.monitor.recorder import Recorder
+
+def replica(path, rid, counters, gauges, waits):
+    rec = Recorder(traced_hooks=False, name=rid)
+    for name, v in counters:
+        rec.counter(name, v)
+    for name, v in gauges:
+        rec.gauge(name, v)
+    for v in waits:
+        rec.observe("serve/queue_wait_ms", v)
+    text = export.render_prometheus(export.snapshot(recorder=rec),
+                                    replica=rid)
+    with open(path, "w") as f:
+        f.write(text)
+
+replica("/tmp/ci_fleet_h1.prom", "h1",
+        [("serve/tokens_generated", 120)],
+        [("serve/pages_in_use", 4.0), ("serve/queue_depth", 0.0)],
+        [4.0, 9.0, 15.0])
+replica("/tmp/ci_fleet_h2.prom", "h2",
+        [("serve/tokens_generated", 80)],
+        [("serve/pages_in_use", 7.0), ("serve/queue_depth", 1.0)],
+        [3.0, 6.0, 11.0])
+replica("/tmp/ci_fleet_starved.prom", "starved",
+        [("serve/tokens_generated", 10),
+         ("health/admission_starvation", 3)],
+        [("serve/pages_in_use", 30.0), ("serve/queue_depth", 6.0)],
+        [65000.0, 70000.0, 90000.0])
+print("ci: fleet fixtures written (h1/h2 healthy, starved)")
+EOF
+python -m apex_tpu.monitor fleet \
+    /tmp/ci_fleet_h1.prom /tmp/ci_fleet_h2.prom --once --json \
+    > /tmp/ci_fleet_healthy.json || {
+  echo "ci: fleet CLI flagged a HEALTHY pair (false alert)"; fail=1; }
+python - /tmp/ci_fleet_healthy.json <<'EOF' || fail=1
+import json, sys
+v = json.load(open(sys.argv[1]))
+assert v["n_up"] == 2 and v["n_replicas"] == 2, v
+assert v["counters"]["apex_serve_tokens_generated_total"] == 200, \
+    v["counters"]
+assert "apex_serve_queue_wait_ms" in v["hist_summary"], \
+    sorted(v["hist_summary"])
+assert not v["alerts"] and not v["decisions"], (v["alerts"],
+                                                v["decisions"])
+print(f"ci: fleet healthy pair ok — 2/2 up, counters summed, "
+      f"merged p99(queue_wait)="
+      f"{v['hist_summary']['apex_serve_queue_wait_ms']['p99']:g} ms, "
+      f"no alerts")
+EOF
+python -m apex_tpu.monitor fleet \
+    /tmp/ci_fleet_h1.prom /tmp/ci_fleet_starved.prom --once \
+    > /tmp/ci_fleet_starved.txt && {
+  echo "ci: fleet CLI read green on a STARVED replica"; fail=1; }
+grep -q "^ALERT \[" /tmp/ci_fleet_starved.txt || {
+  echo "ci: starved fleet poll exited non-zero but rendered no ALERT"
+  fail=1; }
+grep -q "^DECISION \[scale_out\]" /tmp/ci_fleet_starved.txt || {
+  echo "ci: starved fleet poll rendered no scale_out decision"
+  fail=1; }
+grep -E "^ALERT \[" /tmp/ci_fleet_starved.txt | head -2
 
 echo "== ci: monitor timeline (Perfetto trace shape check) =="
 # the smoke stream must fuse into a valid Chrome-trace JSON; the shape
