@@ -609,6 +609,137 @@ def _serve_prefill_step():
     return fn, (params, state, bt, length, ids), mesh.axis_names
 
 
+def _serve_verify_step():
+    """The speculative VERIFY invocation of the serve decode program
+    under tp=2 (ISSUE 20): rows ``0..k`` of the fixed-capacity batch
+    carry ``k+1`` CONSECUTIVE positions of ONE sequence — the last
+    committed token plus the draft tokens, each row writing its K/V
+    before any row attends, per-row ``seq_lens`` masking causality.
+    The compiled program is the decode program (that identity is the
+    greedy-parity theorem), but the usage pattern exercises the
+    repeated-block-table gather and multi-row write path, and the same
+    axis hazards as decode apply (row-parallel psums, the full-vocab
+    logits gather) — so the window shape gets its own gate."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from apex_tpu._compat import shard_map
+    from apex_tpu.models.gpt import GPT, GPTConfig
+    from apex_tpu.serve import cache as cache_mod
+    from apex_tpu.serve import model as serve_model
+    from apex_tpu.serve import rules as serve_rules
+
+    cfg = GPTConfig(vocab_size=32, max_seq_len=32, hidden_size=16,
+                    num_layers=1, num_heads=2, dtype=jnp.float32)
+    # init at tp=1 (full layout) BEFORE installing the tp=2 mesh, like
+    # the decode/prefill serve entrypoints
+    from apex_tpu.transformer import parallel_state as ps
+    ps.destroy_model_parallel()
+    params = GPT(cfg).init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))["params"]
+    mesh, tp, _ = _mesh_for(tp=2)
+    ccfg = cache_mod.CacheConfig(num_layers=1, kv_heads=2, head_dim=8,
+                                 num_pages=4, page_size=8)
+    state = cache_mod.init_cache(ccfg)
+
+    def verify(params, state, bt, pos, tok, act):
+        logits, state = serve_model.decode_forward(
+            cfg, ccfg, params, state, bt, pos, tok, act,
+            paged_impl="reference")
+        return logits, jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+            state
+
+    pspec = serve_rules.match_serve_rules(serve_rules.GPT_PARAM_RULES,
+                                          params, world=tp)
+    cspec = serve_rules.match_serve_rules(serve_rules.CACHE_RULES,
+                                          state, world=tp)
+    inner = shard_map(verify, mesh=mesh,
+                      in_specs=(pspec, cspec, P(), P(), P(), P()),
+                      out_specs=(P(), P(), cspec), check_vma=False)
+    # donate_argnums=() is the APX007 conscious opt-out: traced
+    # abstractly only — the REAL verify call (ServeEngine._spec_round)
+    # goes through the donated decode program
+    fn = jax.jit(inner, donate_argnums=())
+    # a k=2 verify window: rows 0..2 at positions 5..7 of one
+    # sequence, the SAME block table repeated per row, row 3 inactive
+    bt = jnp.tile(jnp.asarray([[1, 2]], jnp.int32), (4, 1))
+    pos = jnp.asarray([5, 6, 7, 0], jnp.int32)
+    tok = jnp.asarray([3, 9, 4, 0], jnp.int32)
+    act = jnp.asarray([True, True, True, False])
+    return fn, (params, state, bt, pos, tok, act), mesh.axis_names
+
+
+def _fp8_weight_decode_step():
+    """The serve decode step with fp8 WEIGHT-streaming engaged
+    (ISSUE 20): the block linear kernels quantized once to e4m3 with
+    per-tensor scales (``serve.model.quantize_gpt_weights``) and read
+    back through the fused dequant-matmul, whose blocks resolve from a
+    builder-seeded tuned cache at trace time — so the Pallas
+    ``fp8_matmul`` kernel (not the pure-XLA dequant reference the
+    ineligible-shape path keeps) is what the zero-findings gate traces.
+    The geometry is chosen 128-aligned on purpose: every linear is
+    kernel-eligible, and a silently-dead lookup fails the builder's
+    assert."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    from apex_tpu.models.gpt import GPT, GPTConfig
+    from apex_tpu.serve import cache as cache_mod
+    from apex_tpu.serve import model as serve_model
+    from apex_tpu.tune import TuneCache, cache_key
+    from apex_tpu.tune import runtime as tune_rt
+    from apex_tpu.transformer import parallel_state as ps
+
+    mesh, _, _ = _mesh_for()
+    ps.destroy_model_parallel()
+    cfg = GPTConfig(vocab_size=32, max_seq_len=32, hidden_size=128,
+                    num_layers=1, num_heads=2, dtype=jnp.float32)
+    params = GPT(cfg).init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))["params"]
+    qparams = serve_model.quantize_gpt_weights(cfg, params)
+    ccfg = cache_mod.CacheConfig(num_layers=1, kv_heads=2, head_dim=64,
+                                 num_pages=4, page_size=8)
+    state = cache_mod.init_cache(ccfg)
+    B = 2
+    tmp = tempfile.mkdtemp(prefix="apexlint_tune_fp8mm_")
+    cache = TuneCache(tmp)
+    qkv_shape = None
+    # one tuned entry per block-linear geometry (qkv/proj/fc1/fc2); the
+    # decode batch is the m extent
+    for k_dim, n_dim in ((128, 3 * 128), (128, 128), (128, cfg.ffn),
+                         (cfg.ffn, 128)):
+        shape = {"m": B, "k": k_dim, "n": n_dim, "itemsize": 4}
+        if qkv_shape is None:
+            qkv_shape = shape
+        cache.put(cache_key("fp8_matmul", shape, "float32", {}),
+                  {"block_k": 128, "block_n": 128})
+
+    def run(params, state, bt, pos, tok, act):
+        # block resolution is trace-time host work: point the lookup
+        # at the builder's cache for the duration of the trace
+        with tune_rt.override_cache_dir(tmp):
+            got = tune_rt.resolve("fp8_matmul", qkv_shape, "float32",
+                                  {}, policy="cache")
+            assert got == {"block_k": 128, "block_n": 128}, \
+                f"lint entrypoint fp8mm cache did not resolve: {got}"
+            logits, state = serve_model.decode_forward(
+                cfg, ccfg, params, state, bt, pos, tok, act,
+                paged_impl="reference", interpret=True,
+                autotune="cache")
+        return logits, state
+
+    # donate_argnums=() is the APX007 conscious opt-out: traced
+    # abstractly only — the REAL step (ServeEngine._build_steps)
+    # donates the cache pytree
+    fn = jax.jit(run, donate_argnums=())
+    bt = jnp.zeros((B, 4), jnp.int32)
+    pos = jnp.zeros((B,), jnp.int32)
+    tok = jnp.zeros((B,), jnp.int32)
+    act = jnp.ones((B,), bool)
+    return fn, (qparams, state, bt, pos, tok, act), mesh.axis_names
+
+
 def _fused_layer_norm_step():
     """A cache-resolved fused-LayerNorm fwd+bwd step (ISSUE 13): the
     builder writes a tuned ``fused_layer_norm`` block into a throwaway
@@ -848,6 +979,8 @@ register_entrypoint("profiled_train_step", _profiled_train_step)
 register_entrypoint("memory_profiled_step", _memory_profiled_step)
 register_entrypoint("serve_decode_step", _serve_decode_step)
 register_entrypoint("serve_prefill_step", _serve_prefill_step)
+register_entrypoint("serve_verify_step", _serve_verify_step)
+register_entrypoint("fp8_weight_decode_step", _fp8_weight_decode_step)
 register_entrypoint("fused_lm_head_ce", _fused_lm_head_ce)
 register_entrypoint("amp_o2_master_step", _amp_o2_master_step)
 register_entrypoint("pp_1f1b_model_step", _pp_1f1b_model_step)

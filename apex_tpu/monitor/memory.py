@@ -693,6 +693,41 @@ def serve_pool_report(*, num_layers: int = 12, kv_heads: int = 16,
     return out
 
 
+def serve_weight_report(cfg, params, *, record: bool = False) -> dict:
+    """Serve weight-streaming accounting: the HBM bytes of block linear
+    weights (kernels + any fp8 scales) ONE decode step streams, against
+    the bf16 baseline of the same leaves — the byte accounting the
+    bench's fp8-weight streamed-bytes assertion reads
+    (``serve.model.weight_stream_bytes``; same rule the engine serves
+    with, so telemetry and capacity claims cannot drift apart). A bf16
+    tree reports ratio 1.0; an e4m3-quantized tree
+    (``serve.quantize_gpt_weights``) ~0.5."""
+    from apex_tpu.serve import model as serve_model
+
+    streamed = serve_model.weight_stream_bytes(cfg, params)
+    elems = 0
+    for i in range(cfg.num_layers):
+        blk = params[f"block_{i}"]
+        for group, name in serve_model._FP8_WEIGHT_LINEARS:
+            elems += int(blk[group][name]["kernel"].size)
+    bf16 = 2 * elems
+    out = {
+        "weight_bytes_per_step": streamed,
+        "bf16_weight_bytes_per_step": bf16,
+        "weight_stream_ratio": round(streamed / max(bf16, 1), 4),
+    }
+    if record:
+        rec = _state.recorder
+        if rec is not None:
+            rec.gauge("memory/serve_weight_bytes",
+                      out["weight_bytes_per_step"])
+            rec.gauge("memory/serve_weight_bytes_bf16",
+                      out["bf16_weight_bytes_per_step"])
+            rec.gauge("memory/serve_weight_ratio",
+                      out["weight_stream_ratio"])
+    return out
+
+
 # ---------------------------------------------------------------------------
 # tuner-loop calibration: envelope predictions vs compiled temp bytes
 # ---------------------------------------------------------------------------

@@ -22,5 +22,10 @@ from apex_tpu.ops.fused_ce import (  # noqa: F401
     softmax_cross_entropy_with_smoothing,
 )
 from apex_tpu.ops.mlp import mlp_forward  # noqa: F401
+from apex_tpu.ops.fp8_matmul import (  # noqa: F401
+    fp8_dequant_matmul,
+    fp8_dequant_matmul_reference,
+    quantize_weight,
+)
 from apex_tpu.ops.flash_attention import flash_attention  # noqa: F401
 from apex_tpu.ops.lm_head_ce import fused_lm_head_cross_entropy  # noqa: F401

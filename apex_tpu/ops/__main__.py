@@ -24,8 +24,9 @@ lm_head_ce: ``n,v,h,dtype,smoothing``; decode_attention (the serve
 KV-cache page-size sweep): ``b,kv,group,s,d,dtype,fp8``;
 fused_layer_norm: ``n,h,dtype``; xentropy: ``n,v,dtype,smoothing``;
 multi_tensor_update (the fused optimizer sweep; fp32 by contract):
-``n,lamb``. Flash sweeps tune the forward and backward INDEPENDENTLY
-(two cache entries per shape).
+``n,lamb``; fp8_matmul (the serve weight-streaming dequant-matmul):
+``m,k,n,dtype``. Flash sweeps tune the forward and backward
+INDEPENDENTLY (two cache entries per shape).
 """
 
 from __future__ import annotations
@@ -41,7 +42,8 @@ def _cmd_tune(args) -> int:
 
     cache = TuneCache(directory=args.cache)
     kernels = (["flash_attention", "lm_head_ce", "decode_attention",
-                "fused_layer_norm", "xentropy", "multi_tensor_update"]
+                "fused_layer_norm", "xentropy", "multi_tensor_update",
+                "fp8_matmul"]
                if args.kernel == "all" else [args.kernel])
     if args.list:
         print("tunable kernels (default sweep shapes):")
@@ -127,7 +129,8 @@ def main(argv=None) -> int:
     t.add_argument("--kernel", default="all",
                    choices=["all", "flash_attention", "lm_head_ce",
                             "decode_attention", "fused_layer_norm",
-                            "xentropy", "multi_tensor_update"])
+                            "xentropy", "multi_tensor_update",
+                            "fp8_matmul"])
     t.add_argument("--shapes", action="append", metavar="SPEC",
                    help="key=value,... shape spec (repeatable); default: "
                         "the bench model shapes")

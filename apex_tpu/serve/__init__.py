@@ -35,14 +35,17 @@ Quick start (see ``examples/serve_gpt.py`` / ``docs/serve.md``)::
 from apex_tpu.serve.cache import (CacheConfig, CacheState, init_cache,
                                   resolve_page_size)
 from apex_tpu.serve.engine import ServeEngine, naive_generate
+from apex_tpu.serve.model import quantize_gpt_weights, weight_stream_bytes
 from apex_tpu.serve.rules import (CACHE_RULES, GPT_PARAM_RULES,
                                   match_serve_rules)
 from apex_tpu.serve.scheduler import (PageAllocator, Scheduler, Sequence,
                                       StepPlan)
+from apex_tpu.serve.spec import accept_greedy, derive_draft
 
 __all__ = [
     "CacheConfig", "CacheState", "init_cache", "resolve_page_size",
     "ServeEngine", "naive_generate", "CACHE_RULES", "GPT_PARAM_RULES",
     "match_serve_rules", "PageAllocator", "Scheduler", "Sequence",
-    "StepPlan",
+    "StepPlan", "accept_greedy", "derive_draft", "quantize_gpt_weights",
+    "weight_stream_bytes",
 ]

@@ -1,23 +1,40 @@
 """The serve engine: jitted prefill/decode steps with a donated cache,
 driven by the continuous-batching scheduler.
 
-Shape discipline — the engine compiles exactly TWO programs and reuses
-them for the whole serving lifetime (replay after a preemption goes
-through the same decode program; that reuse IS the bit-exactness
+Shape discipline — the engine compiles at most THREE programs and
+reuses them for the whole serving lifetime (replay after a preemption
+goes through the same decode program; that reuse IS the bit-exactness
 argument below):
 
 - the **prefill step** runs one sequence at the static padded prompt
   length (``max_prompt_len``);
 - the **decode step** runs the full fixed-capacity batch
-  (``max_batch`` slots, inactive slots masked to the null page).
+  (``max_batch`` slots, inactive slots masked to the null page). With
+  ``spec_k > 0`` the SAME compiled decode program doubles as the
+  speculative **verifier**: rows ``0..k`` carry ``k+1`` consecutive
+  positions of ONE sequence (the last committed token plus the draft
+  tokens) — legal because every row's K/V writes land before any row
+  attends and per-row ``seq_lens`` mask causality;
+- the **draft-decode step** (``spec_k > 0`` only) is the decode
+  program compiled for the depth-truncated draft model over its own
+  page pool (:mod:`apex_tpu.serve.spec`).
 
 Fixed shapes are not just a compile-cache nicety: because no operation
 in the forward mixes batch rows, a slot's row is a function of that
 slot's inputs alone, independent of batch company — so replaying a
 preempted sequence's generated tokens through the SAME decode program
 reproduces its cache and logits BIT-exactly (asserted in
-``tests/test_serve.py``). The cache pytree is donated through both
-steps: the pool updates in place, never 2x resident.
+``tests/test_serve.py``), and speculative greedy output is
+token-identical to plain paged decode (``tests/test_serve_spec.py``).
+The cache pytrees are donated through all steps: the pools update in
+place, never 2x resident.
+
+fp8 weight-streaming (``fp8_weights=True``): the block linear kernels
+quantize ONCE at engine build to e4m3 with per-tensor scales
+(:func:`apex_tpu.serve.model.quantize_gpt_weights`), cutting the
+weight bytes every decode step streams ~2x vs bf16; the forward reads
+them through the fused dequant-matmul (``ops.fp8_matmul``). Orthogonal
+to and composable with speculative decoding.
 
 Tensor parallelism: with a model-parallel mesh installed
 (``parallel_state.initialize_model_parallel(tp)``), both steps wrap in
@@ -58,6 +75,7 @@ from apex_tpu.monitor import spans as _mspans
 from apex_tpu.serve import cache as cache_mod
 from apex_tpu.serve import model as model_mod
 from apex_tpu.serve import rules as rules_mod
+from apex_tpu.serve import spec as spec_mod
 from apex_tpu.serve.scheduler import RUNNING, Scheduler, Sequence
 from apex_tpu.transformer import parallel_state as ps
 
@@ -92,9 +110,22 @@ class ServeEngine:
                  autotune: Optional[str] = None,
                  record_logits: bool = False,
                  interpret: Optional[bool] = None,
-                 replica_id: Optional[str] = None):
+                 replica_id: Optional[str] = None,
+                 spec_k: int = 0,
+                 draft_num_layers: Optional[int] = None,
+                 draft_cfg: Optional[GPTConfig] = None,
+                 draft_params=None,
+                 fp8_weights: bool = False,
+                 fp8_weight_margin: float = 0.0):
         d_impl, p_impl = _default_impls()
         self.cfg = cfg
+        self.fp8_weights = bool(fp8_weights)
+        if fp8_weights:
+            # one-time e4m3 encode of the block linear kernels: same
+            # tree shape (+ scalar scale leaves), so the TP rules and
+            # shard_map specs below apply unchanged
+            params = model_mod.quantize_gpt_weights(
+                cfg, params, margin=fp8_weight_margin)
         self.params = params
         # stable replica identity for fleet telemetry: labels every
         # exported sample (monitor.export) and keys this engine in a
@@ -110,6 +141,7 @@ class ServeEngine:
         self.paged_impl = paged_impl or d_impl
         self.attention_impl = attention_impl or p_impl
         self.interpret = interpret
+        self.autotune = autotune
         self.tp = ps.get_tensor_model_parallel_world_size()
         if cfg.num_heads % self.tp:
             raise ValueError(f"num_heads {cfg.num_heads} not divisible "
@@ -136,8 +168,55 @@ class ServeEngine:
             head_dim=head_dim, num_pages=num_pages, page_size=psize,
             dtype=cfg.dtype, fp8=fp8_kv, fp8_margin=fp8_margin)
         self.state = cache_mod.init_cache(self.ccfg)
+        self.spec_k = int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if self.spec_k:
+            if self.spec_k + 1 > max_batch:
+                raise ValueError(
+                    f"spec_k={spec_k} needs max_batch >= {spec_k + 1} "
+                    f"(the verify window rides the decode batch rows), "
+                    f"got max_batch={max_batch}")
+            if fp8_kv:
+                # the fp8-KV slot-0 scale rule is sequential: a verify
+                # window crossing a page boundary would scatter the old
+                # and the fresh page scale to the SAME pool index in
+                # one call (undefined order) and quantize later rows
+                # with the stale scale — bit-parity with plain decode
+                # would silently break
+                raise ValueError("spec_k > 0 does not compose with "
+                                 "fp8_kv (per-page slot-0 scales need "
+                                 "sequential writes)")
+            if draft_params is None:
+                layers = draft_num_layers or max(1, cfg.num_layers // 2)
+                self.draft_cfg, self.draft_params = spec_mod.derive_draft(
+                    cfg, self.params, num_layers=layers)
+            else:
+                if draft_cfg is None:
+                    raise ValueError("draft_params requires draft_cfg")
+                self.draft_cfg = draft_cfg
+                self.draft_params = (
+                    model_mod.quantize_gpt_weights(
+                        draft_cfg, draft_params, margin=fp8_weight_margin)
+                    if fp8_weights else draft_params)
+            if self.draft_cfg.num_heads % self.tp:
+                raise ValueError(f"draft num_heads "
+                                 f"{self.draft_cfg.num_heads} not "
+                                 f"divisible by tp {self.tp}")
+            # the draft pool mirrors the target pool's geometry
+            # (num_pages, page_size) so the draft REUSES each
+            # sequence's block table — zero new allocator state
+            self.draft_ccfg = cache_mod.CacheConfig(
+                num_layers=self.draft_cfg.num_layers,
+                kv_heads=self.draft_cfg.num_heads,
+                head_dim=(self.draft_cfg.hidden_size
+                          // self.draft_cfg.num_heads),
+                num_pages=num_pages, page_size=psize,
+                dtype=self.draft_cfg.dtype)
+            self.draft_state = cache_mod.init_cache(self.draft_ccfg)
         self.sched = Scheduler(num_pages=num_pages, page_size=psize,
-                               max_batch=max_batch)
+                               max_batch=max_batch,
+                               lookahead=self.spec_k)
         self.max_batch = max_batch
         self.slots: List[Optional[Sequence]] = [None] * max_batch
         self.record_logits = record_logits
@@ -156,7 +235,8 @@ class ServeEngine:
         def decode(params, state, bt, pos, tok, act):
             logits, state = model_mod.decode_forward(
                 cfg, ccfg, params, state, bt, pos, tok, act,
-                paged_impl=self.paged_impl, interpret=self.interpret)
+                paged_impl=self.paged_impl, interpret=self.interpret,
+                autotune=self.autotune)
             return logits, jnp.argmax(logits, axis=-1).astype(jnp.int32), \
                 state
 
@@ -164,9 +244,21 @@ class ServeEngine:
             logits, state = model_mod.prefill_forward(
                 cfg, ccfg, params, state, bt, length, ids,
                 attention_impl=self.attention_impl,
-                interpret=self.interpret)
+                interpret=self.interpret, autotune=self.autotune)
             return logits, jnp.argmax(logits, axis=-1).astype(jnp.int32), \
                 state
+
+        draft = None
+        if self.spec_k:
+            dcfg, dccfg = self.draft_cfg, self.draft_ccfg
+
+            def draft(params, state, bt, pos, tok, act):
+                # greedy draft: only the argmaxes leave the program
+                logits, state = model_mod.decode_forward(
+                    dcfg, dccfg, params, state, bt, pos, tok, act,
+                    paged_impl=self.paged_impl, interpret=self.interpret,
+                    autotune=self.autotune)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), state
 
         if self.tp > 1:
             mesh = ps.get_mesh()
@@ -183,11 +275,24 @@ class ServeEngine:
                 prefill, mesh=mesh,
                 in_specs=(pspec, cspec, P(), P(), P()),
                 out_specs=(P(), P(), cspec), check_vma=False)
+            if draft is not None:
+                dpspec = rules_mod.match_serve_rules(
+                    rules_mod.GPT_PARAM_RULES, self.draft_params,
+                    world=self.tp)
+                dcspec = rules_mod.match_serve_rules(
+                    rules_mod.CACHE_RULES, self.draft_state,
+                    world=self.tp)
+                draft = shard_map(
+                    draft, mesh=mesh,
+                    in_specs=(dpspec, dcspec, P(), P(), P(), P()),
+                    out_specs=(P(), dcspec), check_vma=False)
         # the cache pytree (arg 1) is donated: the pool mutates in
         # place across steps, never two copies resident (APX007's
         # convention for state threaded through a hot loop)
         self._decode = jax.jit(decode, donate_argnums=(1,))
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
+        self._draft_decode = (jax.jit(draft, donate_argnums=(1,))
+                              if draft is not None else None)
 
     # -- request intake ----------------------------------------------
 
@@ -274,6 +379,115 @@ class ServeEngine:
             self._record(seq, j + 1, logits[slot])
             seq.num_cached = j + 1
 
+    # -- speculative decoding ----------------------------------------
+
+    def _blank_batch(self):
+        return (np.zeros((self.max_batch,), np.int32),
+                np.zeros((self.max_batch,), np.int32),
+                np.zeros((self.max_batch,), bool),
+                np.zeros((self.max_batch, self.pages_per_seq), np.int32))
+
+    def _draft_propose(self, seq: Sequence, bt: np.ndarray,
+                       k: int) -> List[int]:
+        """Draft ``k`` tokens for one sequence. First ingests the
+        not-yet-drafted committed positions ``draft_cached..n-1``
+        through the draft-decode program — up to ``max_batch``
+        CONSECUTIVE POSITIONS of this one sequence per call (legal for
+        the same reason verify is: writes land before reads, per-row
+        ``seq_lens`` mask causality) — which both rebuilds the draft
+        cache over any rejected-round garbage and, via the last live
+        row (the feed of ``tokens[n-1]``), yields the first proposal.
+        Then ``k-1`` single-row calls extend speculatively."""
+        n = seq.num_tokens
+        d1 = None
+        for lo in range(seq.draft_cached, n, self.max_batch):
+            hi = min(lo + self.max_batch, n)
+            tok, pos, act, bts = self._blank_batch()
+            cnt = hi - lo
+            tok[:cnt] = seq.tokens[lo:hi]
+            pos[:cnt] = np.arange(lo, hi, dtype=np.int32)
+            act[:cnt] = True
+            bts[:cnt] = bt
+            nxt, self.draft_state = self._draft_decode(
+                self.draft_params, self.draft_state, jnp.asarray(bts),
+                jnp.asarray(pos), jnp.asarray(tok), jnp.asarray(act))
+            if hi == n:
+                d1 = int(np.asarray(nxt)[cnt - 1])
+        seq.draft_cached = n
+        draft = [d1]
+        for j in range(1, k):
+            tok, pos, act, bts = self._blank_batch()
+            tok[0] = draft[-1]
+            pos[0] = n - 1 + j
+            act[0] = True
+            bts[0] = bt
+            nxt, self.draft_state = self._draft_decode(
+                self.draft_params, self.draft_state, jnp.asarray(bts),
+                jnp.asarray(pos), jnp.asarray(tok), jnp.asarray(act))
+            draft.append(int(np.asarray(nxt)[0]))
+        return draft
+
+    def _spec_round(self, seq: Sequence) -> None:
+        """One speculative round for one sequence: draft ``k`` tokens,
+        verify all ``k+1`` positions in ONE call of the compiled decode
+        program (rows 0..k = positions ``n-1..n-1+k``; row 0 feeds the
+        last committed token, rows 1..k the draft), then commit the
+        longest accepted prefix + the verifier's bonus token
+        (:func:`apex_tpu.serve.spec.accept_greedy`) — at least one
+        token per round, token-identical to plain greedy decode.
+        Rejected-suffix K/V in both pools is overwritten by the next
+        round's window before any row can attend to it (rows only read
+        positions <= their own)."""
+        n = seq.num_tokens
+        remaining = seq.max_new_tokens - seq.num_generated
+        k = min(self.spec_k, remaining - 1)
+        bt = self._bt_row(seq)
+        draft: List[int] = []
+        if k > 0:
+            with _mspans.span("serve/draft", parent=seq.span,
+                              seq_id=seq.seq_id, k=k):
+                draft = self._draft_propose(seq, bt, k)
+        tok, pos, act, bts = self._blank_batch()
+        tok[0] = seq.tokens[-1]
+        if k > 0:
+            tok[1:k + 1] = draft
+        pos[:k + 1] = (n - 1) + np.arange(k + 1, dtype=np.int32)
+        act[:k + 1] = True
+        bts[:k + 1] = bt
+        t0 = time.perf_counter()
+        with _mspans.span("serve/verify", parent=seq.span,
+                          seq_id=seq.seq_id, rows=k + 1):
+            logits, next_toks, self.state = self._decode(
+                self.params, self.state, jnp.asarray(bts),
+                jnp.asarray(pos), jnp.asarray(tok), jnp.asarray(act))
+            next_np = np.asarray(next_toks)
+        logits_np = np.asarray(logits) if self.record_logits else None
+        dt = time.perf_counter() - t0
+        self.decode_step_times.append(dt)
+        committed, m = spec_mod.accept_greedy(
+            draft, [int(t) for t in next_np[:k + 1]])
+        # the target cache is now valid through position n-1+m (the
+        # committed window rows); the draft cache through n-1+min(m,
+        # k-1) — position n-1+j holds d_j's K/V, and d_k was never fed
+        seq.num_cached = n + m
+        if k > 0:
+            seq.draft_cached = n + min(m, k - 1)
+        _mhooks.counter("serve/spec_rounds")
+        if k > 0:
+            _mhooks.counter("serve/spec_draft_tokens", k)
+            _mhooks.counter("serve/spec_accepted_tokens", m)
+            _mhooks.observe("serve/spec_accept_rate", m / k)
+        for i, t in enumerate(committed):
+            if logits_np is not None:
+                self._record(seq, n + i, logits_np[i])
+            self._sample(seq, t)
+        if _mhooks.enabled():
+            per_tok = 1e3 * dt / len(committed)
+            for _ in committed:
+                _mhooks.observe("serve/token_latency_ms", per_tok)
+            _mhooks.gauge("serve/batch_fill",
+                          (k + 1) / self.max_batch)
+
     def _do_prefill(self, seq: Sequence) -> None:
         slot = self.slots.index(None)
         self.slots[slot] = seq
@@ -320,7 +534,14 @@ class ServeEngine:
             self._do_prefill(seq)
         decodes = [s for s in plan.decode
                    if not s.done and s.state == RUNNING]
-        if decodes:
+        if decodes and self.spec_k:
+            # speculative mode: one draft+verify round per sequence
+            # (the verify window owns the batch rows)
+            for seq in decodes:
+                if seq.done or seq.state != RUNNING:
+                    continue
+                self._spec_round(seq)
+        elif decodes:
             tok = np.zeros((self.max_batch,), np.int32)
             pos = np.zeros((self.max_batch,), np.int32)
             act = np.zeros((self.max_batch,), bool)

@@ -66,6 +66,85 @@ def _apply(mod, sub, x):
     return mod.apply({"params": sub}, x)
 
 
+def _linear(mod, sub, x, *, row=False, autotune=None, interpret=None):
+    """One block linear, dispatching on the param LEAVES: a sub-tree
+    carrying a ``scale`` sibling (written by :func:`quantize_gpt_weights`)
+    streams its kernel as e4m3 through the fused dequant-matmul
+    (``ops.fp8_matmul``, resolution explicit > tuned cache > reference);
+    otherwise the ordinary TP layer module applies. The fp8 path
+    replays the layer's TP semantics by hand — column shards need no
+    collective in a serve forward, row shards psum — so the SAME
+    shard_map in_specs serve both modes (the e4m3 kernel keeps the bf16
+    kernel's shape, and the scalar scale falls to the rules'
+    replicate catch-all)."""
+    if "scale" not in sub:
+        return _apply(mod, sub, x)
+    from apex_tpu.ops import fp8_matmul as fp8mm
+    y = fp8mm.fp8_dequant_matmul(x, sub["kernel"], sub["scale"],
+                                 out_dtype=x.dtype, autotune=autotune,
+                                 interpret=interpret)
+    if row and ps.get_tensor_model_parallel_world_size() > 1:
+        y = tp_mappings.reduce_from_tensor_model_parallel_region(
+            y, ps.TENSOR_AXIS)
+    if "bias" in sub:
+        y = y + sub["bias"].astype(y.dtype)
+    return y
+
+
+_FP8_WEIGHT_LINEARS = (("attn", "qkv"), ("attn", "proj"),
+                       ("mlp", "fc1"), ("mlp", "fc2"))
+
+
+def _as_dict(tree):
+    """Shallow plain-dict view of a mapping (dict or FrozenDict)."""
+    return {k: tree[k] for k in tree}
+
+
+def quantize_gpt_weights(cfg: GPTConfig, params, *, margin: float = 0.0):
+    """Per-tensor e4m3 quantization of every block linear kernel
+    (qkv / proj / fc1 / fc2): each ``kernel`` leaf is replaced by its
+    fp8 encoding plus a sibling scalar ``scale`` leaf (amax-derived,
+    :func:`apex_tpu.ops.fp8_matmul.quantize_weight`). Embeddings,
+    positionals, norms and biases stay in their training dtype — they
+    are a rounding error of the streamed bytes. Runs ONCE at engine
+    build; the returned tree serves through the same shard_map specs
+    (shapes unchanged; scales replicate)."""
+    from apex_tpu.ops import fp8_matmul as fp8mm
+    out = _as_dict(params)
+    for i in range(cfg.num_layers):
+        blk = _as_dict(out[f"block_{i}"])
+        for group, name in _FP8_WEIGHT_LINEARS:
+            grp = _as_dict(blk[group])
+            lin = _as_dict(grp[name])
+            q, scale = fp8mm.quantize_weight(lin["kernel"], margin=margin)
+            lin["kernel"] = q
+            lin["scale"] = scale
+            grp[name] = lin
+            blk[group] = grp
+        out[f"block_{i}"] = blk
+    return out
+
+
+def weight_stream_bytes(cfg: GPTConfig, params) -> int:
+    """HBM bytes of the block linear weights one decode step streams
+    (kernels + fp8 scales; biases/norms excluded on both sides so the
+    fp8-vs-bf16 ratio measures exactly what quantization changed).
+    Host-side ints — the ``monitor.memory`` serve weight accounting and
+    the bench's streamed-bytes assertion both come from here."""
+    import numpy as np
+    total = 0
+    for i in range(cfg.num_layers):
+        blk = params[f"block_{i}"]
+        for group, name in _FP8_WEIGHT_LINEARS:
+            lin = blk[group][name]
+            kern = lin["kernel"]
+            total += kern.size * np.dtype(kern.dtype).itemsize
+            if "scale" in lin:
+                scale = lin["scale"]
+                total += scale.size * np.dtype(scale.dtype).itemsize
+    return int(total)
+
+
 def _split_qkv(cfg: GPTConfig, qkv):
     """[..., 3h/tp] -> q, k, v [..., heads_per, d] (the GPT packing:
     per-head [q|k|v] groups, so the tp column shard is a head split)."""
@@ -89,31 +168,36 @@ def _logits(cfg: GPTConfig, mods, params, x):
         return logits.astype(jnp.float32)
 
 
-def _mlp(cfg: GPTConfig, mods, blk, x):
-    y = _apply(mods["fc1"], blk["mlp"]["fc1"], x)
+def _mlp(cfg: GPTConfig, mods, blk, x, lin_kw):
+    y = _linear(mods["fc1"], blk["mlp"]["fc1"], x, **lin_kw)
     y = jax.nn.gelu(y.astype(jnp.float32), approximate=True).astype(x.dtype)
-    return _apply(mods["fc2"], blk["mlp"]["fc2"], y)
+    return _linear(mods["fc2"], blk["mlp"]["fc2"], y, row=True, **lin_kw)
 
 
-def _block_forward(cfg: GPTConfig, mods, blk, x, attend):
+def _block_forward(cfg: GPTConfig, mods, blk, x, attend, lin_kw=None):
     """One transformer block — the ONE copy of the serve-side block
     structure (shared by decode, prefill and the no-cache baseline).
     ``attend(q, k, v)`` owns the per-variant cache interaction and
     returns the context in ``x``'s leading shape + ``[..., local_h]``.
+    ``lin_kw`` threads the fp8-weight resolution knobs
+    (autotune/interpret) into the four block linears.
     """
+    lin_kw = lin_kw or {}
     h1 = _apply(mods["ln"], blk["ln1"], x)
-    q, k, v = _split_qkv(cfg, _apply(mods["qkv"], blk["attn"]["qkv"], h1))
+    q, k, v = _split_qkv(cfg, _linear(mods["qkv"], blk["attn"]["qkv"], h1,
+                                      **lin_kw))
     ctx = attend(q, k, v)
-    x = x + _apply(mods["proj"], blk["attn"]["proj"],
-                   ctx.astype(cfg.dtype))
+    x = x + _linear(mods["proj"], blk["attn"]["proj"],
+                    ctx.astype(cfg.dtype), row=True, **lin_kw)
     h2 = _apply(mods["ln"], blk["ln2"], x)
-    return x + _mlp(cfg, mods, blk, h2)
+    return x + _mlp(cfg, mods, blk, h2, lin_kw)
 
 
 def decode_forward(cfg: GPTConfig, ccfg: cache_mod.CacheConfig, params,
                    state: cache_mod.CacheState, block_tables, positions,
                    tokens, active, *, paged_impl: str = "reference",
-                   interpret: Optional[bool] = None):
+                   interpret: Optional[bool] = None,
+                   autotune: Optional[str] = None):
     """One decode step over a fixed-capacity batch.
 
     ``tokens``/``positions``/``active``: [B] (the token being fed, its
@@ -130,6 +214,7 @@ def decode_forward(cfg: GPTConfig, ccfg: cache_mod.CacheConfig, params,
                          f"{paged_impl!r}")
     mods = _mods(cfg)
     B = tokens.shape[0]
+    lin_kw = dict(autotune=autotune, interpret=interpret)
     with _prof.scope("serve_decode"):
         x = _apply(mods["wte"], params["wte"], tokens)
         x = (x + jnp.take(params["wpe"], positions, axis=0)).astype(cfg.dtype)
@@ -168,7 +253,7 @@ def decode_forward(cfg: GPTConfig, ccfg: cache_mod.CacheConfig, params,
 
             with _prof.scope(f"block_{i}"):
                 x = _block_forward(cfg, mods, params[f"block_{i}"], x,
-                                   attend)
+                                   attend, lin_kw)
         x = _apply(mods["ln"], params["ln_f"], x)
         return _logits(cfg, mods, params, x), state_box[0]
 
@@ -176,7 +261,8 @@ def decode_forward(cfg: GPTConfig, ccfg: cache_mod.CacheConfig, params,
 def prefill_forward(cfg: GPTConfig, ccfg: cache_mod.CacheConfig, params,
                     state: cache_mod.CacheState, block_table, length,
                     ids, *, attention_impl: str = "reference",
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    autotune: Optional[str] = None):
     """Full-prompt pass for ONE sequence (padded to the engine's static
     prompt length). ``ids``: [S] int32 (padded with anything past
     ``length``); ``block_table``: [m] int32 — pages covering positions
@@ -190,6 +276,7 @@ def prefill_forward(cfg: GPTConfig, ccfg: cache_mod.CacheConfig, params,
     mods = _mods(cfg)
     S = ids.shape[0]
     d = cfg.hidden_size // cfg.num_heads
+    lin_kw = dict(autotune=autotune, interpret=interpret)
     with _prof.scope("serve_prefill"):
         x = _apply(mods["wte"], params["wte"], ids[None])
         x = (x + params["wpe"][None, :S]).astype(cfg.dtype)
@@ -206,7 +293,7 @@ def prefill_forward(cfg: GPTConfig, ccfg: cache_mod.CacheConfig, params,
 
             with _prof.scope(f"block_{i}"):
                 x = _block_forward(cfg, mods, params[f"block_{i}"], x,
-                                   attend)
+                                   attend, lin_kw)
         x = _apply(mods["ln"], params["ln_f"], x)
         x_last = jnp.take(x[0], length - 1, axis=0)
         return _logits(cfg, mods, params, x_last), state_box[0]

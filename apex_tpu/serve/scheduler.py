@@ -60,6 +60,7 @@ class Sequence:
     pages: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None         # engine batch slot while RUNNING
     num_cached: int = 0                # positions with K/V in the pool
+    draft_cached: int = 0              # positions in the DRAFT pool
     n_preemptions: int = 0
     # -- telemetry (host-only; None/0 when monitoring is detached) ----
     span: Optional[int] = None         # serve/request span id
@@ -127,10 +128,16 @@ class StepPlan:
 
 
 class Scheduler:
-    def __init__(self, *, num_pages: int, page_size: int, max_batch: int):
+    def __init__(self, *, num_pages: int, page_size: int, max_batch: int,
+                 lookahead: int = 0):
         self.allocator = PageAllocator(num_pages)
         self.page_size = page_size
         self.max_batch = max_batch
+        # speculative decoding writes up to ``lookahead`` positions past
+        # the next decode position in one round (the verify window), so
+        # growth/admission must cover them up front — a preemption
+        # mid-window would otherwise strand a half-written round
+        self.lookahead = int(lookahead)
         self.waiting: List[Sequence] = []
         self.running: List[Sequence] = []
         self._arrival = 0
@@ -156,6 +163,7 @@ class Scheduler:
         seq.pages = []
         seq.slot = None
         seq.num_cached = 0
+        seq.draft_cached = 0
         _mhooks.counter("serve/requests_finished")
 
     @property
@@ -174,6 +182,9 @@ class Scheduler:
         seq.pages = []
         seq.slot = None
         seq.num_cached = 0
+        # the draft pool reuses the target's page ids, so eviction
+        # invalidates the draft cache too — re-admission re-ingests
+        seq.draft_cached = 0
         # evict/re-queue transition on the request trace: annotation on
         # the request span + a fresh queue-wait span (re-admission will
         # close it and add the second wait to the request's total)
@@ -198,7 +209,8 @@ class Scheduler:
         plan = StepPlan()
 
         # 1. growth: every running sequence must hold pages for its
-        # next decode write (position num_tokens-1). Earliest arrivals
+        # next decode write (position num_tokens-1) plus the
+        # speculative lookahead window. Earliest arrivals
         # are served first; exhaustion preempts the LATEST-arrived
         # running sequence — possibly the grower itself, when it is the
         # latest.
@@ -206,8 +218,9 @@ class Scheduler:
             if seq.state != RUNNING:
                 continue                    # preempted earlier this pass
             grown = True
-            while self._pages_needed(seq.num_tokens) > len(seq.pages):
-                need = self._pages_needed(seq.num_tokens) - len(seq.pages)
+            want = self._pages_needed(seq.num_tokens + self.lookahead)
+            while want > len(seq.pages):
+                need = want - len(seq.pages)
                 got = self.allocator.alloc(need)
                 if got is not None:
                     seq.pages.extend(got)
@@ -226,7 +239,7 @@ class Scheduler:
         # recompute) plus the next write.
         while self.waiting and len(self.running) < self.max_batch:
             seq = self.waiting[0]
-            need = self._pages_needed(seq.num_tokens + 1)
+            need = self._pages_needed(seq.num_tokens + 1 + self.lookahead)
             if need > self.allocator.num_pages - 1:
                 raise RuntimeError(
                     f"sequence {seq.seq_id} needs {need} pages; the pool "

@@ -1,0 +1,92 @@
+"""Speculative decoding: draft/verify machinery for the serve engine.
+
+The scheme is the standard draft-then-verify loop (Leviathan et al.;
+vLLM's ``spec_decode``), specialized to this engine's fixed-shape
+greedy contract:
+
+- a **draft model** — the SAME GPT stack truncated to its first
+  ``num_layers`` blocks (:func:`derive_draft`; shared wte/wpe/ln_f, so
+  no new weights exist) — proposes ``k`` tokens per scheduler round
+  through its own paged cache;
+- the **target model verifies all k+1 positions in ONE call of the
+  existing decode program**: rows ``0..k`` of the fixed-capacity batch
+  carry positions ``n-1 .. n-1+k`` of a single sequence (token row 0 is
+  the last committed token, rows 1..k the draft tokens). This works
+  because ``decode_forward`` writes EVERY row's K/V per layer before
+  any row attends, and per-row ``seq_lens = position + 1`` provides the
+  causal mask — so row ``i`` attends over the committed prefix plus the
+  draft prefix written by rows ``< i`` in the same call. No verify
+  program exists: the engine still compiles exactly three programs
+  (prefill, decode-=-verify, draft-decode);
+- **host-side greedy acceptance** (:func:`accept_greedy`): commit the
+  longest draft prefix matching the verifier's own argmaxes plus the
+  verifier's next token ("bonus"). Because no op in the forward mixes
+  batch rows, each verify row is bitwise the plain-decode row at the
+  same (token, position, cache) — so greedy speculative output is
+  TOKEN-IDENTICAL to plain paged decode (asserted in
+  ``tests/test_serve_spec.py``), and every round commits at least one
+  token (``k = 0`` degenerates to plain decode exactly).
+
+Everything here is pure host math / host tree surgery — no jax device
+work, no new compiled shapes. The engine owns the cache bookkeeping
+(``Sequence.draft_cached``, rejected-suffix overwrite; see
+``docs/serve.md``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence as Seq, Tuple
+
+from apex_tpu.models.gpt import GPTConfig
+
+
+def accept_greedy(draft_tokens: Seq[int],
+                  verify_argmax: Seq[int]) -> Tuple[List[int], int]:
+    """Greedy accept/reject for one speculative round.
+
+    ``draft_tokens``: the ``k`` proposed tokens ``d_1..d_k``.
+    ``verify_argmax``: the ``k+1`` verifier argmaxes ``a_0..a_k``,
+    where ``a_i`` is the target's greedy token given the committed
+    prefix plus ``d_1..d_i``.
+
+    Returns ``(committed, num_accepted)``: ``committed`` is
+    ``d_1..d_m`` plus the bonus token ``a_m`` (``m+1`` tokens, always
+    at least one), where ``m`` is the longest prefix with
+    ``d_i == a_{i-1}``. By induction each committed token equals the
+    plain greedy token at its index — ``a_0`` IS the greedy
+    continuation, ``d_1 == a_0`` makes ``a_1`` the greedy token one
+    past it, and so on. ``k = 0`` commits ``[a_0]``: plain decode.
+    """
+    k = len(draft_tokens)
+    if len(verify_argmax) != k + 1:
+        raise ValueError(f"need {k + 1} verifier argmaxes for {k} draft "
+                         f"tokens, got {len(verify_argmax)}")
+    m = 0
+    while m < k and int(draft_tokens[m]) == int(verify_argmax[m]):
+        m += 1
+    committed = [int(t) for t in draft_tokens[:m]]
+    committed.append(int(verify_argmax[m]))
+    return committed, m
+
+
+def derive_draft(cfg: GPTConfig, params, *,
+                 num_layers: int) -> Tuple[GPTConfig, dict]:
+    """Depth-truncated draft: the target's first ``num_layers`` blocks
+    with the SHARED embedding / positional / final-norm weights.
+
+    Zero new parameters and zero training: the truncated stack is a
+    legitimate (if crude) draft — early blocks carry most of the
+    next-token signal on small models, and the acceptance test is
+    exact, so a bad draft costs only speed, never correctness. The
+    returned tree references the original leaves (no copy).
+    """
+    if not (1 <= num_layers <= cfg.num_layers):
+        raise ValueError(f"draft num_layers must be in [1, "
+                         f"{cfg.num_layers}], got {num_layers}")
+    draft_cfg = dataclasses.replace(cfg, num_layers=num_layers)
+    draft_params = {"wte": params["wte"], "wpe": params["wpe"],
+                    "ln_f": params["ln_f"]}
+    for i in range(num_layers):
+        draft_params[f"block_{i}"] = params[f"block_{i}"]
+    return draft_cfg, draft_params

@@ -47,7 +47,8 @@ CONFIG_KEYS = {"flash_attention_fwd": frozenset(("block_q", "block_k")),
                "decode_attention": frozenset(("block_kv",)),
                "fused_layer_norm": frozenset(("block_r",)),
                "xentropy": frozenset(("block_t", "block_v")),
-               "multi_tensor_update": frozenset(("block_n",))}
+               "multi_tensor_update": frozenset(("block_n",)),
+               "fp8_matmul": frozenset(("block_k", "block_n"))}
 
 
 def _pow2_ceil(x: int) -> int:
@@ -101,6 +102,11 @@ def shape_bucket(kernel: str, shape: dict) -> str:
         return f"n{_pow2_ceil(shape['n'])}_v{_pow2_ceil(shape['v'])}"
     if kernel == "multi_tensor_update":
         return f"n{_pow2_ceil(shape['n'])}"
+    if kernel == "fp8_matmul":
+        # rows bucket pow2 (the decode batch); the weight geometry is
+        # pinned exactly — it IS the tile-extent the blocks trade against
+        return (f"m{_pow2_ceil(shape.get('m', 1))}_k{shape['k']}"
+                f"_n{shape['n']}")
     raise ValueError(f"unknown kernel {kernel!r}")
 
 

@@ -60,6 +60,12 @@ DEFAULT_SHAPES = {
         dict(n=16 * 1024 * 1024, dtype="float32"),
         dict(n=128 * 1024 * 1024, dtype="float32", lamb=True),
     ],
+    # the serve weight-streaming dequant-matmul at the GPT bench
+    # geometry: qkv ([h, 3h]) and fc2 ([4h, h]) at decode batch sizes
+    "fp8_matmul": [
+        dict(m=8, k=768, n=2304, dtype="bfloat16"),
+        dict(m=8, k=3072, n=768, dtype="bfloat16"),
+    ],
 }
 
 
@@ -84,6 +90,8 @@ def parse_shape_spec(kernel: str, spec: str) -> dict:
         known = {"n", "v", "dtype", "smoothing"}
     elif kernel == "multi_tensor_update":
         known = {"n", "dtype", "lamb"}
+    elif kernel == "fp8_matmul":
+        known = {"m", "k", "n", "dtype"}
     else:
         known = {"n", "v", "h", "dtype", "smoothing"}
     # the optimizer update is fp32 math by contract (zero/update.py);
@@ -141,6 +149,11 @@ def parse_shape_spec(kernel: str, spec: str) -> dict:
     elif kernel == "multi_tensor_update":
         if "n" not in out:
             raise ValueError("multi_tensor_update shape spec needs n")
+    elif kernel == "fp8_matmul":
+        out.setdefault("m", 8)
+        for req in ("k", "n"):
+            if req not in out:
+                raise ValueError(f"fp8_matmul shape spec needs {req}")
     else:
         for req in ("n", "v", "h"):
             if req not in out:
@@ -163,7 +176,7 @@ def split_shape(kernel: str, spec: dict):
                  for k in ("causal", "bias", "dropout", "segments")}
     elif kernel == "decode_attention":
         flags = {"fp8": bool(spec.pop("fp8", False))}
-    elif kernel == "fused_layer_norm":
+    elif kernel in ("fused_layer_norm", "fp8_matmul"):
         flags = {}
     elif kernel == "multi_tensor_update":
         flags = {"lamb": bool(spec.pop("lamb", False))}
@@ -401,13 +414,41 @@ def build_multi_tensor_update(shape: dict, dtype: str, flags: dict, *,
     return build
 
 
+def build_fp8_matmul(shape: dict, dtype: str, flags: dict, *,
+                     interpret: Optional[bool] = None):
+    """``build(config)``: one jitted fused dequant-matmul over a
+    synthetic e4m3-quantized weight at the candidate
+    ``(block_k, block_n)`` tiles (serve weight-streaming's decode
+    read)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    rng = np.random.RandomState(0)
+    m, k, n = shape.get("m", 8), shape["k"], shape["n"]
+    dt = _np_dtype(dtype)
+    x = jnp.asarray(rng.randn(m, k) * 0.1, dt)
+    from apex_tpu.ops.fp8_matmul import quantize_weight
+    q, scale = quantize_weight(jnp.asarray(rng.randn(k, n) * 0.05,
+                                           jnp.float32))
+
+    def build(config):
+        from apex_tpu.ops.fp8_matmul import fp8_dequant_matmul
+
+        fn = jax.jit(lambda x, q, scale: fp8_dequant_matmul(
+            x, q, scale, block_k=config["block_k"],
+            block_n=config["block_n"], interpret=interpret))
+        return lambda: jax.block_until_ready(fn(x, q, scale))
+    return build
+
+
 _BUILDERS = {"flash_attention_fwd": build_flash_fwd,
              "flash_attention_bwd": build_flash_bwd,
              "lm_head_ce": build_lm_head_ce,
              "decode_attention": build_decode_attention,
              "fused_layer_norm": build_fused_layer_norm,
              "xentropy": build_xentropy,
-             "multi_tensor_update": build_multi_tensor_update}
+             "multi_tensor_update": build_multi_tensor_update,
+             "fp8_matmul": build_fp8_matmul}
 
 
 def tune_one(kernel: str, shape: dict, dtype: str, flags: dict, *,

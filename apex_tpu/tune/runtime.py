@@ -132,6 +132,15 @@ def _config_sane(kernel: str, cfg: dict, shape: dict, flags: dict) -> bool:
             return (cfg["block_n"] % 1024 == 0
                     and vmem.fits(kernel, block_n=cfg["block_n"],
                                   itemsize=itemsize))
+        if kernel == "fp8_matmul":
+            # both tiles ride a 128-lane extent (block_k is also the
+            # e4m3 weight's sublane dim — 128 covers the (32, 128) tile)
+            return (cfg["block_k"] % 128 == 0
+                    and cfg["block_n"] % 128 == 0
+                    and vmem.fits(kernel, block_k=cfg["block_k"],
+                                  block_n=cfg["block_n"],
+                                  group=shape.get("m", 8),
+                                  itemsize=itemsize))
         return False
     except Exception:
         return False

@@ -33,6 +33,11 @@ _LN_BLOCKS = (2048, 1024, 512, 256, 128, 64, 32, 16, 8)
 # because the kernel views the flat buffer as [rows, 128]
 _MTU_BLOCKS = (262144, 131072, 65536, 32768, 16384, 8192, 4096, 2048,
                1024)
+# contraction/output tiles for the fp8 dequant-matmul: block_k rides
+# both x's lane dim and the e4m3 weight's sublane dim (fp8 tiling wants
+# 32-sublane multiples — every 128 qualifies), block_n the output lanes
+_FP8MM_BLOCKS_K = (512, 256, 128)
+_FP8MM_BLOCKS_N = (2048, 1024, 512, 256, 128)
 
 
 def _pow2_ceil(x: int) -> int:
@@ -133,6 +138,19 @@ def multi_tensor_update_space(*, n: int, itemsize: int = 4) -> list[dict]:
     return out
 
 
+def fp8_matmul_space(*, m: int, k: int, n: int,
+                     itemsize: int = 2) -> list[dict]:
+    """Legal ``{"block_k", "block_n"}`` candidates for the fused fp8
+    dequant-matmul (serve weight-streaming)."""
+    out = []
+    for bk in _clip_menu(_FP8MM_BLOCKS_K, k):
+        for bn in _clip_menu(_FP8MM_BLOCKS_N, n):
+            if vmem.fits("fp8_matmul", block_k=bk, block_n=bn,
+                         group=max(m, 1), itemsize=itemsize):
+                out.append({"block_k": bk, "block_n": bn})
+    return out
+
+
 def config_space(kernel: str, shape: dict,
                  flags: Optional[dict] = None) -> list[dict]:
     """Dispatch on the cache's kernel naming: ``flash_attention_fwd``,
@@ -163,4 +181,8 @@ def config_space(kernel: str, shape: dict,
     if kernel == "multi_tensor_update":
         return multi_tensor_update_space(
             n=shape["n"], itemsize=shape.get("itemsize", 4))
+    if kernel == "fp8_matmul":
+        return fp8_matmul_space(
+            m=shape.get("m", 8), k=shape["k"], n=shape["n"],
+            itemsize=shape.get("itemsize", 2))
     raise ValueError(f"unknown kernel {kernel!r}; known: {vmem.KERNELS}")

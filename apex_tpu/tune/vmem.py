@@ -40,7 +40,7 @@ LM_HEAD_VMEM_LIMIT = 64 * 1024 * 1024
 
 KERNELS = ("flash_attention_fwd", "flash_attention_bwd", "lm_head_ce",
            "decode_attention", "fused_layer_norm", "xentropy",
-           "multi_tensor_update")
+           "multi_tensor_update", "fp8_matmul")
 
 # Donation-worthiness threshold for the APXJ105 lint check (and anyone
 # else asking "is this state big enough that an undonated round trip
@@ -84,7 +84,7 @@ def tree_nbytes(tree) -> int:
 def budget_for(kernel: str) -> int:
     if kernel in ("flash_attention_fwd", "flash_attention_bwd",
                   "decode_attention", "fused_layer_norm", "xentropy",
-                  "multi_tensor_update"):
+                  "multi_tensor_update", "fp8_matmul"):
         # the r13 kernels run under Mosaic's unraised scoped-VMEM
         # default, so they share the flash envelope budget
         return FLASH_VMEM_BUDGET
@@ -168,6 +168,21 @@ def vmem_estimate(kernel: str, *, block_q: int = 0, block_k: int = 0,
         # inputs (p/g/m/v), 3 double-buffered fp32 outputs, plus ~4
         # elementwise temps before Mosaic's buffer reuse kicks in
         return (2 * 4 + 2 * 3 + 4) * block_n * 4
+    if kernel == "fp8_matmul":
+        # the serve weight-streaming dequant-matmul: ``group`` is the
+        # padded activation row count (decode batches are tiny — 16
+        # covers the bf16 sublane tile). Double-buffered activation
+        # blocks in their native dtype, 1-byte e4m3 weight blocks, the
+        # in-VMEM fp32 dequant temp, the fp32 x cast, and the revisited
+        # fp32 output block + one partial-product tile; the scalar
+        # scale rides SMEM and disappears into the headroom.
+        g16 = max(16, -(-int(group) // 16) * 16)
+        x_blocks = 2 * g16 * block_k * itemsize
+        w_blocks = 2 * block_k * block_n * 1
+        deq = block_k * block_n * 4
+        x32 = g16 * block_k * 4
+        out = 2 * g16 * block_n * 4
+        return x_blocks + w_blocks + deq + x32 + out
     if kernel == "lm_head_ce":
         # the _pick_blocks budget math, promoted: fp32 dE accumulator
         # block + fp32 logits tile + double-buffered E/x operand blocks

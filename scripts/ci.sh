@@ -64,7 +64,7 @@
 #                    the tune/vmem calibration rows
 #   5. regress     — python -m apex_tpu.monitor regress: the smoke
 #                    stream must load as an evidence round, and the
-#                    committed BENCH_r01-r09 rounds must degrade exactly
+#                    committed BENCH_r01-r10 rounds must degrade exactly
 #                    as documented (r05 no-evidence, r01 incomparable,
 #                    cpu-host rounds unit-marked, memory byte keys
 #                    registered lower-better) with no false regression
@@ -104,6 +104,7 @@ d = json.load(open(sys.argv[1]))
 eps = set(d.get("entrypoints_analyzed", []))
 tabs = set(d.get("rules_tables_checked", []))
 missing_eps = {"serve_decode_step", "serve_prefill_step",
+               "serve_verify_step", "fp8_weight_decode_step",
                "zero3_train_step", "fp8_train_step",
                "fused_layer_norm_step", "zero_fused_update_step",
                "memory_profiled_step", "amp_o2_master_step",
@@ -176,7 +177,7 @@ for line in open(sys.argv[1]):
 missing = {"tp_overlap", "ddp_bucket_overlap", "pp_zero_bubble",
            "zero_sharded_step", "fp8_step", "autotune", "fused_ln",
            "multi_tensor_update", "profile", "serve_decode",
-           "serve_fleet", "memory"} - seen
+           "serve_spec", "serve_fleet", "memory"} - seen
 if missing:
     print(f"ci: sections missing from bench stream: {sorted(missing)}")
     raise SystemExit(1)
@@ -194,6 +195,26 @@ if missing_slo and not any(k.endswith(("_error", "_skipped"))
                            for k in serve):
     print(f"ci: serve section lost span-derived SLO keys: "
           f"{sorted(missing_slo)} (have: {sorted(serve)[:20]})")
+    raise SystemExit(1)
+# the serve_spec section's claims must land with their evidence: the
+# spec-vs-plain speedup AND the parity-checked throughputs AND the
+# fp8 weight-byte ratio (measured through monitor.memory) — a
+# speculative-decoding section that silently lost an assert input
+# must not read green
+spec = next(ev.get("data") or {} for ev in
+            map(json.loads, open(sys.argv[1]))
+            if ev.get("kind") == "section"
+            and ev.get("name") == "serve_spec")
+spec_keys = {"serve_spec_speedup_vs_plain", "serve_spec_accept_rate",
+             "serve_spec_tokens_per_sec",
+             "serve_spec_plain_tokens_per_sec",
+             "serve_spec_draft_step_speedup",
+             "serve_fp8_weight_bytes_ratio"}
+missing_spec = spec_keys - set(spec)
+if missing_spec and not any(k.endswith(("_error", "_skipped"))
+                            for k in spec):
+    print(f"ci: serve_spec section lost its evidence keys: "
+          f"{sorted(missing_spec)} (have: {sorted(spec)[:20]})")
     raise SystemExit(1)
 # the memory section's byte claims must come THROUGH monitor.memory:
 # the stream line carries the re-derived ZeRO residency + pool keys
@@ -213,8 +234,9 @@ if missing_mem and not any(k.endswith(("_error", "_skipped"))
     raise SystemExit(1)
 print("ci: tp_overlap + ddp_bucket_overlap + pp_zero_bubble + "
       "zero_sharded_step + fp8_step + autotune + fused_ln + "
-      "multi_tensor_update + profile + serve_decode + serve_fleet + "
-      "memory present in bench stream (serve SLO keys span-derived, "
+      "multi_tensor_update + profile + serve_decode + serve_spec + "
+      "serve_fleet + memory present in bench stream (serve SLO keys "
+      "span-derived, spec speedup/parity/fp8-weight evidence present, "
       "memory byte keys re-derived through monitor.memory)")
 EOF
 
@@ -380,16 +402,16 @@ echo "== ci: bench-trajectory regression gate (monitor.regress) =="
 #    are exercised on every CI run)
 python -m apex_tpu.monitor regress /tmp/ci_bench_smoke_stream.jsonl \
     --json > /tmp/ci_regress_smoke.json || fail=1
-# 2) the committed rounds r01-r08 must degrade exactly as documented:
+# 2) the committed rounds r01-r10 must degrade exactly as documented:
 #    r05 is a no-evidence row (rc=124), r01 is incomparable with r02+
-#    (the unit-methodology change), the cpu-host rounds (r06-r08) are
+#    (the unit-methodology change), the cpu-host rounds (r06-r10) are
 #    unit-marked so platform-bound metrics never cross-compare, and no
-#    false regression fires
+#    false regression fires (two-digit round filenames from r10 on)
 python - <<'EOF' || fail=1
 import json, subprocess, sys
 p = subprocess.run(
     [sys.executable, "-m", "apex_tpu.monitor", "regress",
-     *[f"BENCH_r0{i}.json" for i in range(1, 10)], "--json"],
+     *[f"BENCH_r{i:02d}.json" for i in range(1, 11)], "--json"],
     capture_output=True, text=True)
 if p.returncode != 0:
     print(f"ci: regress over committed rounds exited {p.returncode}:\n"
@@ -399,6 +421,7 @@ rep = json.loads(p.stdout)
 by = {r["round"]: r for r in rep["rounds"]}
 assert by["r05"]["status"] == "no-evidence", by["r05"]
 assert by["r09"]["status"] == "ok", by["r09"]
+assert by["r10"]["status"] == "ok", by["r10"]
 inc = rep["metrics"]["value"].get("incomparable") or []
 assert any(i["round"] == "r01" for i in inc), rep["metrics"]["value"]
 # the r13 kernel cost-model keys are platform-independent: they must be
@@ -412,7 +435,10 @@ assert not missing, f"unregistered kernel metric units: {missing}"
 from apex_tpu.monitor.regress import metric_direction
 for k in [m for m in rep["metrics"]
           if m.startswith(("serve_ttft", "serve_p50", "serve_p99",
-                           "serve_queue_wait", "serve_goodput"))
+                           "serve_queue_wait", "serve_goodput",
+                           "serve_spec_tokens", "serve_spec_speedup",
+                           "serve_spec_draft_step_speedup",
+                           "serve_fp8_weight_bytes"))
           or m == "profile_mfu_pct"]:
     u = rep["metrics"][k]["unit"]
     assert u, f"unregistered serve/MFU metric unit: {k}"
@@ -435,7 +461,7 @@ for k in mem_keys:
         assert metric_direction(k, u) == "lower", \
             f"{k} must gate lower-better ({u})"
 assert not rep["regressions"], rep["regressions"]
-print("ci: regress gate ok over r01-r09 (r05 no-evidence, r01 "
+print("ci: regress gate ok over r01-r10 (r05 no-evidence, r01 "
       "incomparable, kernel + serve-SLO/MFU + memory byte metric "
       "units registered lower-better, no false regressions)")
 EOF

@@ -1,0 +1,428 @@
+"""Speculative decoding + fp8 weight-streaming (apex_tpu.serve.spec /
+ops.fp8_matmul).
+
+The acceptance contracts of the serve-speedup PR:
+
+- host-side greedy accept/reject is pure math with exact degenerate
+  behavior (k = 0 IS plain decode; all-rejected still commits the
+  bonus token; all-accepted commits k+1);
+- speculative greedy output is TOKEN-IDENTICAL to plain paged decode
+  AND every recorded logits row is BIT-identical (``array_equal``) —
+  the verify-as-decode argument made mechanical;
+- preempt -> resume under speculation stays bit-exact (the rejected-
+  suffix garbage in both pools is never observable);
+- fp8 weight-streaming: teacher-forced parity within the e4m3
+  round-trip tolerance, spec-vs-plain STILL bitwise at fp8 weights
+  (quantization happens once at build; both paths serve the same
+  tree), and the streamed-bytes ratio <= 0.55x bf16 through
+  ``monitor.memory.serve_weight_report``;
+- the fused dequant-matmul resolves explicit > tuned cache >
+  reference, and ``autotune="off"`` traces the reference jaxpr
+  byte-identically;
+- composition guards: ``spec_k`` needs ``max_batch >= k+1`` rows and
+  refuses fp8-KV (per-page slot-0 scales need sequential writes).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import monitor, serve
+from apex_tpu.models.gpt import GPT, GPTConfig
+from apex_tpu.ops import fp8_matmul as fp8mm
+from apex_tpu.serve import cache as cache_mod
+from apex_tpu.serve import model as serve_model
+from apex_tpu.serve import spec as spec_mod
+from apex_tpu.transformer import parallel_state as ps
+
+
+# ---------------------------------------------------------------------------
+# shared tiny model (the test_serve.py geometry)
+# ---------------------------------------------------------------------------
+
+CFG = GPTConfig(vocab_size=64, max_seq_len=128, hidden_size=32,
+                num_layers=2, num_heads=2, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    ps.destroy_model_parallel()
+    return GPT(CFG).init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 8), jnp.int32))["params"]
+
+
+PROMPTS = [[5, 9, 17, 3, 40, 22, 8], [11, 2, 33, 60, 7, 7, 1]]
+N_NEW = 12
+
+
+def _engine(params, *, num_pages=32, max_batch=4, **kw):
+    return serve.ServeEngine(CFG, params, num_pages=num_pages,
+                             max_seq_len=64, max_prompt_len=16,
+                             page_size=8, max_batch=max_batch,
+                             record_logits=True, **kw)
+
+
+def _run(params, *, preempt_at=None, **kw):
+    eng = _engine(params, **kw)
+    ids = [eng.add_request(p, N_NEW) for p in PROMPTS]
+    seqs = list(eng.sched.waiting)
+    steps = 0
+    while eng.sched.has_work:
+        eng.step()
+        steps += 1
+        if preempt_at and steps == preempt_at and any(
+                s.seq_id == ids[0] for s in eng.sched.running):
+            eng.preempt(ids[0])
+        assert steps < 500
+    out = {s.seq_id: s.tokens[len(s.prompt):] for s in seqs}
+    n_preempts = sum(s.n_preemptions for s in seqs)
+    return eng, ids, out, n_preempts
+
+
+def _assert_logits_bitwise_equal(engA, engB, ids):
+    for sid in ids:
+        la, lb = engA.logits_log[sid], engB.logits_log[sid]
+        assert set(la) == set(lb), (sid, sorted(la), sorted(lb))
+        for pos in la:
+            assert np.array_equal(la[pos], lb[pos]), (sid, pos)
+
+
+# ---------------------------------------------------------------------------
+# accept/reject: pure host math
+# ---------------------------------------------------------------------------
+
+def test_accept_greedy_k0_is_plain_decode():
+    committed, m = spec_mod.accept_greedy([], [7])
+    assert committed == [7] and m == 0
+
+
+def test_accept_greedy_all_rejected_commits_bonus():
+    committed, m = spec_mod.accept_greedy([1, 2, 3], [9, 8, 7, 6])
+    assert committed == [9] and m == 0
+
+
+def test_accept_greedy_all_accepted_commits_k_plus_one():
+    committed, m = spec_mod.accept_greedy([1, 2, 3], [1, 2, 3, 4])
+    assert committed == [1, 2, 3, 4] and m == 3
+
+
+def test_accept_greedy_partial_prefix():
+    # d_1 matches a_0; d_2 != a_1 -> commit [d_1, a_1]
+    committed, m = spec_mod.accept_greedy([5, 9, 9], [5, 2, 9, 9])
+    assert committed == [5, 2] and m == 1
+    # numpy ints compare as ints (the engine feeds np.int32 rows)
+    committed, m = spec_mod.accept_greedy(
+        [np.int32(5)], np.asarray([5, 6], np.int32))
+    assert committed == [5, 6] and m == 1
+    assert all(type(t) is int for t in committed)
+
+
+def test_accept_greedy_length_mismatch_raises():
+    with pytest.raises(ValueError, match="argmaxes"):
+        spec_mod.accept_greedy([1, 2], [1, 2])
+
+
+# ---------------------------------------------------------------------------
+# draft derivation
+# ---------------------------------------------------------------------------
+
+def test_derive_draft_shares_leaves_and_truncates(params):
+    dcfg, dparams = spec_mod.derive_draft(CFG, params, num_layers=1)
+    assert dcfg.num_layers == 1
+    assert dcfg.hidden_size == CFG.hidden_size
+    assert set(dparams) == {"wte", "wpe", "ln_f", "block_0"}
+    # zero new weights: the draft tree REFERENCES the target's leaves
+    assert dparams["wte"] is params["wte"]
+    assert dparams["block_0"] is params["block_0"]
+
+
+def test_derive_draft_bounds(params):
+    for bad in (0, -1, CFG.num_layers + 1):
+        with pytest.raises(ValueError, match="num_layers"):
+            spec_mod.derive_draft(CFG, params, num_layers=bad)
+
+
+# ---------------------------------------------------------------------------
+# spec-vs-plain: token-identical, bit-identical
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("spec_k,layers", [
+    pytest.param(1, 1, marks=pytest.mark.slow),   # edge k, covered by (3, 1)
+    (3, 1),
+    pytest.param(2, 2, marks=pytest.mark.slow),   # full-depth draft variant
+])
+def test_spec_matches_plain_decode_bitwise(params, spec_k, layers):
+    """Greedy speculative output == plain paged decode, token for token
+    AND logits row for logits row (array_equal) — at any k and any
+    draft depth (layers == num_layers: the draft IS the target, every
+    proposal accepted)."""
+    engP, ids, outP, _ = _run(params)
+    engS, idsS, outS, _ = _run(params, spec_k=spec_k,
+                               draft_num_layers=layers)
+    assert ids == idsS
+    assert outP == outS
+    _assert_logits_bitwise_equal(engP, engS, ids)
+    if layers == CFG.num_layers:
+        # full-depth draft: 100% acceptance -> fewer verify calls than
+        # plain decode steps (each round commits k+1 tokens)
+        assert len(engS.decode_step_times) < len(engP.decode_step_times)
+
+
+@pytest.mark.slow
+def test_spec_preempt_resume_bit_exact(params):
+    """Forced preempt mid-speculation: the rejected-suffix garbage in
+    the target AND draft pools is never observable — replay + further
+    spec rounds are bit-identical to the uninterrupted spec run (and to
+    plain decode)."""
+    engP, ids, outP, _ = _run(params)
+    engS, _, outS, _ = _run(params, spec_k=3)
+    engR, _, outR, n_pre = _run(params, spec_k=3, preempt_at=3)
+    assert n_pre >= 1
+    assert outP == outS == outR
+    _assert_logits_bitwise_equal(engP, engS, ids)
+    _assert_logits_bitwise_equal(engS, engR, ids)
+
+
+def test_spec_draft_cache_reset_on_finish(params):
+    """Sequences finish with draft bookkeeping cleared (a re-used
+    Sequence object after preemption must re-ingest from scratch)."""
+    eng, _, _, _ = _run(params, spec_k=2)
+    assert all(s.draft_cached == 0 for s in eng.seqs.values())
+
+
+def test_spec_telemetry_counters(params):
+    rec = monitor.Recorder(traced_hooks=False, name="spec_tel")
+    with monitor.attached(rec):
+        _, _, out, _ = _run(params, spec_k=3)
+    agg = rec.aggregate()
+    c = agg["serve"]["counters"]
+    total = sum(len(v) for v in out.values())
+    assert c["serve/tokens_generated"] == total
+    assert c["serve/spec_rounds"] > 0
+    assert c["serve/spec_draft_tokens"] >= c["serve/spec_accepted_tokens"]
+    # every generated token beyond the prefill samples came from a
+    # spec round: accepted + one bonus per round == decode-path tokens
+    assert (c["serve/spec_accepted_tokens"] + c["serve/spec_rounds"]
+            == total - len(PROMPTS))
+
+
+# ---------------------------------------------------------------------------
+# composition guards
+# ---------------------------------------------------------------------------
+
+def test_spec_k_needs_batch_rows(params):
+    with pytest.raises(ValueError, match="max_batch"):
+        _engine(params, spec_k=4, max_batch=4)
+
+
+def test_spec_k_negative_raises(params):
+    with pytest.raises(ValueError, match=">= 0"):
+        _engine(params, spec_k=-1)
+
+
+def test_spec_refuses_fp8_kv(params):
+    with pytest.raises(ValueError, match="fp8_kv"):
+        _engine(params, spec_k=2, fp8_kv=True)
+
+
+def test_draft_params_require_draft_cfg(params):
+    with pytest.raises(ValueError, match="draft_cfg"):
+        _engine(params, spec_k=2, draft_params=params)
+
+
+# ---------------------------------------------------------------------------
+# fp8 weight-streaming
+# ---------------------------------------------------------------------------
+
+def test_fp8_weights_parity_teacher_forced(params):
+    """e4m3 weights vs exact weights within the round-trip tolerance —
+    TEACHER-FORCED (same token sequence both paths; free-running greedy
+    divergence is chaotic by construction)."""
+    prompt = PROMPTS[0]
+    tail = [14, 3, 59, 22, 8, 41, 30, 7]
+
+    def forced(p):
+        ccfg = cache_mod.CacheConfig(
+            num_layers=CFG.num_layers, kv_heads=CFG.num_heads,
+            head_dim=CFG.hidden_size // CFG.num_heads, num_pages=8,
+            page_size=8, dtype=jnp.float32)
+        state = cache_mod.init_cache(ccfg)
+        bt1 = jnp.asarray([1, 2, 3], jnp.int32)
+        ids = jnp.asarray(prompt + [0] * (16 - len(prompt)), jnp.int32)
+        rows = []
+        logits, state = serve_model.prefill_forward(
+            CFG, ccfg, p, state, bt1, jnp.int32(len(prompt)), ids)
+        rows.append(np.asarray(logits))
+        bts = jnp.asarray([[1, 2, 3]], jnp.int32)
+        for j, tok in enumerate(tail):
+            pos = len(prompt) + j
+            logits, state = serve_model.decode_forward(
+                CFG, ccfg, p, state, bts,
+                jnp.asarray([pos], jnp.int32),
+                jnp.asarray([tok], jnp.int32), jnp.ones((1,), bool))
+            rows.append(np.asarray(logits[0]))
+        return rows
+
+    exact = forced(params)
+    quant = forced(serve_model.quantize_gpt_weights(CFG, params))
+    worst = max(float(np.max(np.abs(a - b))) for a, b in zip(exact, quant))
+    mag = max(float(np.max(np.abs(a))) for a in exact)
+    assert worst < 0.15 * max(mag, 1.0), (worst, mag)
+
+
+def test_fp8_weights_spec_matches_fp8_weights_plain(params):
+    """Quantization happens ONCE at engine build — spec and plain serve
+    the same e4m3 tree, so the bitwise spec-parity contract survives
+    fp8 weight-streaming unchanged."""
+    engP, ids, outP, _ = _run(params, fp8_weights=True)
+    engS, _, outS, _ = _run(params, fp8_weights=True, spec_k=2)
+    assert outP == outS
+    _assert_logits_bitwise_equal(engP, engS, ids)
+    # the engine really is serving a quantized tree
+    qk = engP.params["block_0"]["attn"]["qkv"]
+    assert jnp.dtype(qk["kernel"].dtype) == jnp.dtype(jnp.float8_e4m3fn)
+    assert "scale" in qk
+
+
+def test_fp8_weight_stream_ratio(params):
+    """Streamed-bytes accounting: e4m3 kernels + f32 scalar scales come
+    in at <= 0.55x the bf16 baseline (the ISSUE gate), measured through
+    the same helper the bench and telemetry read."""
+    from apex_tpu.monitor import memory as mmem
+    qparams = serve_model.quantize_gpt_weights(CFG, params)
+    rep = mmem.serve_weight_report(CFG, qparams)
+    assert rep["weight_bytes_per_step"] == \
+        serve_model.weight_stream_bytes(CFG, qparams)
+    assert rep["weight_stream_ratio"] <= 0.55, rep
+    assert 0.4 < rep["weight_stream_ratio"], rep
+    # the full-precision f32 tree streams 2x the bf16 baseline
+    rep32 = mmem.serve_weight_report(CFG, params)
+    assert rep32["weight_stream_ratio"] == 2.0
+
+
+def test_quantize_gpt_weights_shapes_and_rules(params):
+    """Quantization preserves every kernel's SHAPE (the TP shard rules
+    apply unchanged) and the scale leaves fall to the replicate
+    catch-all."""
+    from jax.sharding import PartitionSpec as P
+    qparams = serve_model.quantize_gpt_weights(CFG, params)
+    for i in range(CFG.num_layers):
+        for group, name in serve_model._FP8_WEIGHT_LINEARS:
+            lin = qparams[f"block_{i}"][group][name]
+            orig = params[f"block_{i}"][group][name]
+            assert lin["kernel"].shape == orig["kernel"].shape
+            assert lin["scale"].shape == ()
+    spec = serve.match_serve_rules(serve.GPT_PARAM_RULES, qparams, world=2)
+    blk = spec["block_0"]
+    assert blk["attn"]["qkv"]["kernel"] == P(None, "tensor")
+    assert blk["attn"]["qkv"]["scale"] == P()
+    assert blk["mlp"]["fc2"]["kernel"] == P("tensor", None)
+    assert blk["mlp"]["fc2"]["scale"] == P()
+
+
+# ---------------------------------------------------------------------------
+# ops.fp8_matmul: the fused dequant-matmul
+# ---------------------------------------------------------------------------
+
+def _mk_xq(m, K, N, seed=0):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(m, K) * 0.3, jnp.float32)
+    q, scale = fp8mm.quantize_weight(
+        jnp.asarray(rng.randn(K, N) * 0.3, jnp.float32))
+    return x, q, scale
+
+
+@pytest.mark.parametrize("m", [1, 5])
+def test_fp8_matmul_kernel_matches_reference(m):
+    """Explicit Pallas blocks (interpret) vs the XLA reference — the
+    in-VMEM dequant and blocked fp32 accumulation agree to float32
+    reassociation noise, including the m-pad path (m < 16)."""
+    x, q, scale = _mk_xq(m, 256, 128)
+    ref = fp8mm.fp8_dequant_matmul_reference(x, q, scale)
+    out = fp8mm.fp8_dequant_matmul(x, q, scale, block_k=128, block_n=128,
+                                   interpret=True)
+    assert out.shape == (m, 128) and out.dtype == x.dtype
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               atol=1e-4)
+
+
+def test_fp8_matmul_resolution_order(tmp_path):
+    """explicit > tuned cache > reference, the layer_norm contract:
+    with no knob and no cache entry the call IS the reference
+    (jaxpr-identical); a seeded cache entry flips it to the Pallas
+    kernel; autotune="off" ignores the cache."""
+    from apex_tpu.tune import TuneCache, cache_key
+    from apex_tpu.tune import runtime as tune_rt
+    x, q, scale = _mk_xq(2, 256, 128)
+
+    def jx(**kw):
+        return str(jax.make_jaxpr(
+            lambda a, b, s: fp8mm.fp8_dequant_matmul(a, b, s, **kw)
+        )(x, q, scale))
+
+    ref = str(jax.make_jaxpr(fp8mm.fp8_dequant_matmul_reference)(
+        x, q, scale))
+    # empty cache (conftest pins a fresh dir): reference, bit-for-bit
+    assert jx() == ref
+    # a tuned entry resolves through the same cache the CLI writes
+    cache = TuneCache(str(tmp_path))
+    cache.put(cache_key("fp8_matmul",
+                        {"m": 2, "k": 256, "n": 128, "itemsize": 4},
+                        "float32", {}),
+              {"block_k": 128, "block_n": 128})
+    with tune_rt.override_cache_dir(str(tmp_path)):
+        assert "pallas_call" in jx(interpret=True)
+        # "off" skips the lookup: reference again, jaxpr-identical
+        assert jx(autotune="off") == ref
+    # explicit blocks never consult the cache or the policy
+    assert "pallas_call" in jx(block_k=256, block_n=128,
+                               interpret=True, autotune="off")
+
+
+def test_fp8_matmul_tune_space_and_cli(tmp_path):
+    from apex_tpu.ops.__main__ import main as ops_main
+    from apex_tpu.tune import TuneCache
+    from apex_tpu.tune.space import config_space
+    cands = config_space("fp8_matmul",
+                         {"m": 8, "k": 512, "n": 2048, "itemsize": 2})
+    assert {"block_k": 512, "block_n": 2048} in cands
+    assert {"block_k": 128, "block_n": 128} in cands
+    # blocks clip to the weight extents like flash blocks clip to seq
+    tiny = config_space("fp8_matmul", {"m": 8, "k": 128, "n": 128})
+    assert tiny == [{"block_k": 128, "block_n": 128}]
+    rc = ops_main(["tune", "--kernel", "fp8_matmul", "--shapes",
+                   "m=2,k=128,n=128,dtype=float32", "--cache",
+                   str(tmp_path), "--median-of", "1", "--warmup", "0",
+                   "--interpret", "--json"])
+    assert rc == 0
+    entries = TuneCache(str(tmp_path)).entries()
+    assert any(k.startswith("fp8_matmul|") for k in entries), entries
+
+
+def test_fp8_matmul_guards():
+    x, q, scale = _mk_xq(2, 256, 128)
+    with pytest.raises(ValueError, match="e4m3"):
+        fp8mm.fp8_dequant_matmul(x, x, scale)
+    with pytest.raises(ValueError, match="contraction"):
+        fp8mm.fp8_dequant_matmul(x[:, :128], q, scale)
+    with pytest.raises(ValueError, match="both"):
+        fp8mm.fp8_dequant_matmul(x, q, scale, block_k=128)
+    # ragged weight: the kernel refuses, the reference serves it
+    xr, qr = x[:, :100], q[:100, :100]
+    with pytest.raises(ValueError, match="128-aligned"):
+        fp8mm.fp8_dequant_matmul(xr, qr, scale, block_k=128, block_n=128)
+    out = fp8mm.fp8_dequant_matmul(xr, qr, scale)
+    assert out.shape == (2, 100)
+
+
+def test_quantize_weight_roundtrip():
+    from apex_tpu.amp import fp8
+    rng = np.random.RandomState(3)
+    w = jnp.asarray(rng.randn(64, 32) * 0.5, jnp.float32)
+    q, scale = fp8mm.quantize_weight(w)
+    assert jnp.dtype(q.dtype) == jnp.dtype(fp8.E4M3)
+    back = fp8.dequantize(q, scale, jnp.float32)
+    err = float(jnp.max(jnp.abs(back - w)))
+    assert err < 0.1 * float(jnp.max(jnp.abs(w)))
